@@ -1,0 +1,179 @@
+"""Typed configuration with the reference YAML schema.
+
+The reference passes ``yaml.safe_load`` output around as a raw dict with
+no validation or defaults layer (/root/reference/main.py:9-10,
+/root/reference/config.yaml).  Here the same YAML keys
+(/root/reference/docs/parameters.md schema) load into dataclasses with
+defaults, type checks, and the derived quantities the reference computes
+inline (``num_gathers``: /root/reference/handyrl/worker.py:183-184,
+eval-rate floor: /root/reference/handyrl/train.py:415-416).
+
+``TrainConfig`` also supports item access (``cfg['gamma']``) so code
+that naturally treats it as a mapping (e.g. serializing to workers)
+stays simple.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+POLICY_TARGETS = ("MC", "TD", "VTRACE", "UPGO")
+VALUE_TARGETS = ("MC", "TD", "VTRACE", "UPGO")
+
+
+@dataclass
+class WorkerConfig:
+    num_parallel: int = 6
+    num_gathers: int = 0          # 0 -> derived: 1 + (num_parallel-1)//16
+    base_worker_id: int = 0
+    server_address: str = ""
+
+    def __post_init__(self):
+        if self.num_gathers <= 0:
+            self.num_gathers = 1 + max(0, self.num_parallel - 1) // 16
+
+
+@dataclass
+class EvalConfig:
+    opponent: List[str] = field(default_factory=lambda: ["random"])
+
+
+@dataclass
+class EnvConfig:
+    env: str = "TicTacToe"
+    # arbitrary extra per-env arguments pass through untouched
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"env": self.env, **self.extra}
+
+
+@dataclass
+class TrainConfig:
+    turn_based_training: bool = True
+    observation: bool = False
+    gamma: float = 0.8
+    forward_steps: int = 16
+    burn_in_steps: int = 0
+    compress_steps: int = 4
+    entropy_regularization: float = 1e-1
+    entropy_regularization_decay: float = 0.1
+    update_episodes: int = 200
+    batch_size: int = 128
+    minimum_episodes: int = 400
+    maximum_episodes: int = 100_000
+    epochs: int = -1
+    num_batchers: int = 2
+    eval_rate: float = 0.1
+    lambda_: float = 0.7
+    policy_target: str = "TD"
+    value_target: str = "TD"
+    seed: int = 0
+    restart_epoch: int = 0
+    worker: WorkerConfig = field(default_factory=WorkerConfig)
+    eval: EvalConfig = field(default_factory=EvalConfig)
+    env: Dict[str, Any] = field(default_factory=dict)
+
+    # --- TPU-native additions (absent from the reference) ---
+    # device mesh shape for the learner, e.g. {"dp": 4}; empty = single chip
+    mesh: Dict[str, int] = field(default_factory=dict)
+    # number of device-resident batches to keep prefetched
+    prefetch_batches: int = 2
+    # parameter/compute dtype for the update step
+    compute_dtype: str = "float32"
+    # structured metrics sink (jsonl path); "" disables
+    metrics_path: str = ""
+
+    def __post_init__(self):
+        if self.policy_target not in POLICY_TARGETS:
+            raise ValueError(f"unknown policy_target {self.policy_target!r}")
+        if self.value_target not in VALUE_TARGETS:
+            raise ValueError(f"unknown value_target {self.value_target!r}")
+        if self.forward_steps < 1:
+            raise ValueError("forward_steps must be >= 1")
+        if self.burn_in_steps < 0:
+            raise ValueError("burn_in_steps must be >= 0")
+        if self.compress_steps < 1:
+            raise ValueError("compress_steps must be >= 1")
+        if not 0.0 <= self.eval_rate <= 1.0:
+            raise ValueError("eval_rate must be in [0, 1]")
+
+    # The reference floors the eval rate so at least ~n^0.85 of every
+    # update window is evaluation (/root/reference/handyrl/train.py:415).
+    @property
+    def effective_eval_rate(self) -> float:
+        floor = (self.update_episodes ** 0.85) / self.update_episodes
+        return max(self.eval_rate, floor)
+
+    @property
+    def batch_steps(self) -> int:
+        return self.burn_in_steps + self.forward_steps
+
+    # -- mapping-style access (keys mirror the YAML schema) --
+    _ALIASES = {"lambda": "lambda_"}
+
+    def __getitem__(self, key: str):
+        key = self._ALIASES.get(key, key)
+        value = getattr(self, key)
+        if isinstance(value, (WorkerConfig, EvalConfig)):
+            return dataclasses.asdict(value)
+        return value
+
+    def __contains__(self, key: str) -> bool:
+        try:
+            self[key]
+            return True
+        except AttributeError:
+            return False
+
+    def get(self, key: str, default=None):
+        try:
+            return self[key]
+        except AttributeError:
+            return default
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["lambda"] = d.pop("lambda_")
+        return d
+
+
+def _build_train_config(train_args: Dict[str, Any],
+                        env_args: Dict[str, Any]) -> TrainConfig:
+    args = dict(train_args)
+    if "lambda" in args:
+        args["lambda_"] = args.pop("lambda")
+    worker = WorkerConfig(**args.pop("worker", {}))
+    eval_cfg = EvalConfig(**args.pop("eval", {}))
+    known = {f.name for f in dataclasses.fields(TrainConfig)}
+    unknown = set(args) - known
+    if unknown:
+        raise ValueError(f"unknown train_args keys: {sorted(unknown)}")
+    return TrainConfig(worker=worker, eval=eval_cfg, env=dict(env_args), **args)
+
+
+@dataclass
+class Config:
+    """Top-level config mirroring the reference's three YAML sections."""
+
+    env_args: Dict[str, Any]
+    train_args: TrainConfig
+    worker_args: WorkerConfig
+
+    @classmethod
+    def from_dict(cls, raw: Dict[str, Any]) -> "Config":
+        env_args = dict(raw.get("env_args", {}))
+        train = _build_train_config(raw.get("train_args", {}), env_args)
+        wraw = dict(raw.get("worker_args", {}))
+        wraw.setdefault("num_parallel", 8)
+        worker_args = WorkerConfig(**wraw)
+        return cls(env_args=env_args, train_args=train, worker_args=worker_args)
+
+    @classmethod
+    def load(cls, path: str) -> "Config":
+        with open(path) as f:
+            return cls.from_dict(yaml.safe_load(f))

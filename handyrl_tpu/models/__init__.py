@@ -1,0 +1,1 @@
+from .wrapper import TPUModel, RandomModel, snapshot_params, load_params

@@ -1,0 +1,74 @@
+"""Shared Flax building blocks for policy-value nets.
+
+Conventions (TPU-first):
+  * all convs are NHWC (channel-last) — the natural Flax/XLA layout;
+  * normalization is GroupNorm, not BatchNorm: it is state-free, so the
+    jitted update step needs no mutable batch-stats collection and the
+    burn-in steps of RNN replay behave identically to training steps.
+    (The reference nets use BatchNorm with train/eval mode switching,
+    e.g. /root/reference/handyrl/envs/tictactoe.py:26 — numerics differ
+    slightly, semantics do not.)
+"""
+
+from typing import Any, Callable, Optional, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+def pick_num_groups(channels: int, target: int = 8) -> int:
+    """Largest divisor of ``channels`` that is <= ``target``."""
+    for g in range(min(target, channels), 0, -1):
+        if channels % g == 0:
+            return g
+    return 1
+
+
+class ConvBlock(nn.Module):
+    """3x3 conv -> GroupNorm -> ReLU."""
+
+    filters: int
+    kernel: int = 3
+    use_norm: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(self.filters, (self.kernel, self.kernel),
+                    padding="SAME", use_bias=not self.use_norm)(x)
+        if self.use_norm:
+            x = nn.GroupNorm(num_groups=pick_num_groups(self.filters))(x)
+        return nn.relu(x)
+
+
+class PolicyHead(nn.Module):
+    """1x1 conv bottleneck -> flatten -> dense logits.
+
+    Same shape contract as the reference's ``Head``
+    (/root/reference/handyrl/envs/tictactoe.py:35-46).
+    """
+
+    bottleneck: int
+    num_actions: int
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Conv(self.bottleneck, (1, 1))(x)
+        h = nn.leaky_relu(h, negative_slope=0.1)
+        h = h.reshape((h.shape[0], -1))
+        return nn.Dense(self.num_actions, use_bias=False)(h)
+
+
+class ValueHead(nn.Module):
+    """1x1 conv bottleneck -> flatten -> dense scalar (optionally tanh)."""
+
+    bottleneck: int
+    outputs: int = 1
+    squash: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Conv(self.bottleneck, (1, 1))(x)
+        h = nn.leaky_relu(h, negative_slope=0.1)
+        h = h.reshape((h.shape[0], -1))
+        h = nn.Dense(self.outputs, use_bias=False)(h)
+        return jnp.tanh(h) if self.squash else h

@@ -1,0 +1,27 @@
+"""Policy-value convnet for Tic-Tac-Toe.
+
+Capability parity with the reference ``SimpleConv2dModel``
+(/root/reference/handyrl/envs/tictactoe.py:52-69): stem conv + 3 conv
+blocks at 32 filters, a 9-way policy head and a tanh value head — here
+in Flax NHWC with GroupNorm.
+"""
+
+from flax import linen as nn
+
+from .blocks import ConvBlock, PolicyHead, ValueHead
+
+
+class TicTacToeNet(nn.Module):
+    filters: int = 32
+    blocks: int = 3
+
+    @nn.compact
+    def __call__(self, obs, hidden=None):
+        h = nn.Conv(self.filters, (3, 3), padding="SAME")(obs)
+        h = nn.relu(h)
+        for _ in range(self.blocks):
+            h = ConvBlock(self.filters)(h)
+        return {
+            "policy": PolicyHead(bottleneck=2, num_actions=9)(h),
+            "value": ValueHead(bottleneck=1)(h),
+        }

@@ -1,0 +1,72 @@
+"""Pytree helpers for the actor-side (numpy) data path.
+
+The reference hand-rolls a recursive map family (``map_r``/``bimap_r``/
+``trimap_r``/``rotate``, /root/reference/handyrl/util.py:7-59) to walk
+nested observation/hidden structures.  On the JAX side this is
+``jax.tree_util`` for free; the helpers here cover the actor-side numpy
+path where we also want ``None`` leaves preserved (a ``None`` marks "no
+data for this player this step" and must survive the traversal).
+"""
+
+import numpy as np
+
+
+def tree_map(fn, x):
+    """Map ``fn`` over leaves of a nested list/tuple/dict structure.
+
+    ``None`` is treated as a leaf and passed to ``fn`` (unlike
+    ``jax.tree_util``, which prunes it) because episode moments use
+    ``None`` to mean "player did not act/observe at this step".
+    """
+    if isinstance(x, dict):
+        return {k: tree_map(fn, v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return type(x)(tree_map(fn, v) for v in x)
+    return fn(x)
+
+
+def tree_map2(fn, x, y):
+    """Two-structure zip-map; structure is taken from ``x``."""
+    if isinstance(x, dict):
+        return {k: tree_map2(fn, v, y[k]) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return type(x)(tree_map2(fn, v, y[i]) for i, v in enumerate(x))
+    return fn(x, y)
+
+
+def tree_zeros_like(x):
+    return tree_map(lambda a: None if a is None else np.zeros_like(a), x)
+
+
+def tree_stack(trees, axis=0):
+    """Stack a list of identically-structured trees leaf-wise."""
+    first = trees[0]
+    if isinstance(first, dict):
+        return {k: tree_stack([t[k] for t in trees], axis) for k in first}
+    if isinstance(first, (list, tuple)):
+        return type(first)(
+            tree_stack([t[i] for t in trees], axis) for i in range(len(first))
+        )
+    return np.stack([np.asarray(t) for t in trees], axis=axis)
+
+
+def stack_time_player(moment_rows, template):
+    """Build ``(T, P, ...)`` leaf arrays from a ``[T][P]`` nested list of
+    observation trees, zero-filling ``None`` entries from ``template``.
+
+    This replaces the reference's double-``rotate`` trick
+    (/root/reference/handyrl/train.py:77-78) with a single stack pass.
+    """
+    def fill(entry):
+        return template if entry is None else entry
+
+    return tree_stack(
+        [tree_stack([fill(p) for p in row]) for row in moment_rows]
+    )
+
+
+def softmax_np(x, axis=-1):
+    """Numerically-stable softmax on numpy arrays (actor-side sampling)."""
+    x = np.asarray(x, dtype=np.float32)
+    z = np.exp(x - np.max(x, axis=axis, keepdims=True))
+    return z / z.sum(axis=axis, keepdims=True)

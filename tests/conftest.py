@@ -1,0 +1,15 @@
+"""Test harness: force JAX onto CPU with 8 virtual devices.
+
+This is the TPU-native analog of "multi-node without a cluster": every
+sharding/collective test runs on a virtual 8-device mesh so the full
+multi-chip path compiles and executes in CI with no TPU attached.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
