@@ -1,0 +1,182 @@
+"""Host-side training-batch assembly.
+
+Semantic parity with the reference ``make_batch``
+(/root/reference/handyrl/train.py:33-125): decompress episode moment
+blocks, select the training players (turn-based gathers only the turn
+player; otherwise one random player — or all players when observers
+train too), build ``(T, P, ...)`` arrays with the full mask set, and pad
+short slices to the static ``burn_in + forward_steps`` window.
+
+This runs on CPU (in batcher processes) and emits fixed-shape float32/
+int32 numpy arrays ready for ``jax.device_put`` — static shapes are what
+lets the jitted update step compile once and stream batches forever.
+
+Batch layout (B = batch, T = time, P = players, A = actions):
+  observation      pytree of (B, T, P_in, ...)   P_in = 1 if turn-based
+  selected_prob    (B, T, P_in, 1)   behavior-policy probability
+  action           (B, T, P_in, 1)   int32
+  action_mask      (B, T, P_in, A)   0 legal / 1e32 illegal
+  value/reward/return (B, T, P, V)
+  outcome          (B, 1, P, 1)
+  episode_mask     (B, T, 1, 1)      0 on padding
+  turn_mask        (B, T, P, 1)      1 where the player acted
+  observation_mask (B, T, P, 1)      1 where the player observed
+  progress         (B, T, 1)         fraction of episode elapsed
+"""
+
+import bz2
+import pickle
+import random
+
+import numpy as np
+
+from .utils.tree import tree_map, tree_stack, stack_time_player
+
+ILLEGAL = np.float32(1e32)
+
+
+def decompress_moments(ep):
+    """Inflate an episode's bz2 moment blocks and slice to [start, end)."""
+    blocks = [pickle.loads(bz2.decompress(blob)) for blob in ep["moment"]]
+    moments = [m for block in blocks for m in block]
+    return moments[ep["start"] - ep["base"]: ep["end"] - ep["base"]]
+
+
+def _pad_time(arr, before, after, value=0.0):
+    pad = [(before, after)] + [(0, 0)] * (arr.ndim - 1)
+    return np.pad(arr, pad, constant_values=value)
+
+
+def _episode_tensors(ep, cfg):
+    """Build one episode's (T, P, ...) tensors, padded to batch_steps."""
+    moments = decompress_moments(ep)
+    players = list(moments[0]["observation"].keys())
+    if not cfg["turn_based_training"]:
+        players = [random.choice(players)]
+
+    turn0 = moments[0]["turn"][0]
+    obs_template = tree_map(
+        lambda a: np.zeros_like(a), moments[0]["observation"][turn0]
+    )
+    num_actions = len(moments[0]["action_mask"][turn0])
+
+    if cfg["turn_based_training"] and not cfg["observation"]:
+        # one acting seat per step: gather the turn player's data (P_in = 1)
+        obs_rows = [[m["observation"][m["turn"][0]]] for m in moments]
+        prob = np.array(
+            [[[m["selected_prob"][m["turn"][0]]]] for m in moments], np.float32
+        )
+        act = np.array(
+            [[[m["action"][m["turn"][0]]]] for m in moments], np.int32
+        )
+        amask = np.array(
+            [[m["action_mask"][m["turn"][0]]] for m in moments], np.float32
+        )
+    else:
+        def pick(m, key, p, default):
+            v = m[key][p]
+            return default if v is None else v
+
+        obs_rows = [[m["observation"][p] for p in players] for m in moments]
+        prob = np.array(
+            [[[pick(m, "selected_prob", p, 1.0)] for p in players] for m in moments],
+            np.float32,
+        )
+        act = np.array(
+            [[[pick(m, "action", p, 0)] for p in players] for m in moments], np.int32
+        )
+        amask = np.stack(
+            [
+                np.stack(
+                    [
+                        np.asarray(m["action_mask"][p], np.float32)
+                        if m["action_mask"][p] is not None
+                        else np.full(num_actions, ILLEGAL, np.float32)
+                        for p in players
+                    ]
+                )
+                for m in moments
+            ]
+        )
+
+    obs = stack_time_player(obs_rows, obs_template)  # tree of (T, P_in, ...)
+
+    def channel(key):
+        return np.array(
+            [
+                [
+                    np.ravel(m[key][p]) if m[key][p] is not None else [0.0]
+                    for p in players
+                ]
+                for m in moments
+            ],
+            np.float32,
+        ).reshape(len(moments), len(players), -1)
+
+    v = channel("value")
+    rew = channel("reward")
+    ret = channel("return")
+    oc = np.array(
+        [ep["outcome"][p] for p in players], np.float32
+    ).reshape(1, len(players), 1)
+
+    emask = np.ones((len(moments), 1, 1), np.float32)
+    tmask = np.array(
+        [[[m["selected_prob"][p] is not None] for p in players] for m in moments],
+        np.float32,
+    )
+    omask = np.array(
+        [[[m["observation"][p] is not None] for p in players] for m in moments],
+        np.float32,
+    )
+    progress = (
+        np.arange(ep["start"], ep["end"], dtype=np.float32)[:, None] / ep["total"]
+    )
+
+    # pad short slices to the static window; burn-in alignment keeps the
+    # training start at index burn_in_steps
+    batch_steps = cfg["burn_in_steps"] + cfg["forward_steps"]
+    if len(moments) < batch_steps:
+        pad_b = cfg["burn_in_steps"] - (ep["train_start"] - ep["start"])
+        pad_a = batch_steps - len(moments) - pad_b
+        obs = tree_map(lambda a: _pad_time(a, pad_b, pad_a), obs)
+        prob = _pad_time(prob, pad_b, pad_a, 1.0)
+        # after the terminal step the value bootstrap is the final outcome
+        v = np.concatenate(
+            [_pad_time(v, pad_b, 0), np.tile(oc, [pad_a, 1, 1])]
+        )
+        act = _pad_time(act, pad_b, pad_a)
+        rew = _pad_time(rew, pad_b, pad_a)
+        ret = _pad_time(ret, pad_b, pad_a)
+        emask = _pad_time(emask, pad_b, pad_a)
+        tmask = _pad_time(tmask, pad_b, pad_a)
+        omask = _pad_time(omask, pad_b, pad_a)
+        amask = _pad_time(amask, pad_b, pad_a, ILLEGAL)
+        progress = _pad_time(progress, pad_b, pad_a, 1.0)
+
+    return obs, {
+        "selected_prob": prob,
+        "value": v,
+        "action": act,
+        "outcome": oc,
+        "reward": rew,
+        "return": ret,
+        "episode_mask": emask,
+        "turn_mask": tmask,
+        "observation_mask": omask,
+        "action_mask": amask,
+        "progress": progress,
+    }
+
+
+def make_batch(episodes, cfg):
+    """Assemble a ``(B, T, P, ...)`` training batch from episode slices."""
+    obs_list, datum = [], []
+    for ep in episodes:
+        obs, row = _episode_tensors(ep, cfg)
+        obs_list.append(obs)
+        datum.append(row)
+
+    batch = {k: np.stack([d[k] for d in datum]) for k in datum[0]}
+    batch["observation"] = tree_stack(obs_list)
+    return batch

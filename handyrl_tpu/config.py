@@ -43,16 +43,6 @@ class EvalConfig:
 
 
 @dataclass
-class EnvConfig:
-    env: str = "TicTacToe"
-    # arbitrary extra per-env arguments pass through untouched
-    extra: Dict[str, Any] = field(default_factory=dict)
-
-    def to_dict(self) -> Dict[str, Any]:
-        return {"env": self.env, **self.extra}
-
-
-@dataclass
 class TrainConfig:
     turn_based_training: bool = True
     observation: bool = False

@@ -1,0 +1,120 @@
+"""Self-play episode generation (the actor-side hot loop).
+
+Semantic parity with /root/reference/handyrl/generation.py:20-99: per
+player recurrent hidden state, per-step inference for turn players and
+observers, legal-action masking (illegal logits pushed down by 1e32),
+softmax sampling with the behavior probability recorded for importance
+sampling, immediate rewards, backward discounted returns, and the
+episode packed as bz2-compressed moment blocks.
+
+Runs in CPU actor processes; ``models`` are TPUModel/RandomModel
+instances whose ``inference`` is a CPU-jitted forward.
+"""
+
+import bz2
+import pickle
+import random
+
+import numpy as np
+
+from .utils.tree import softmax_np
+
+MOMENT_KEYS = (
+    "observation", "selected_prob", "action_mask", "action",
+    "value", "reward", "return",
+)
+
+
+class Generator:
+    def __init__(self, env, args):
+        self.env = env
+        self.args = args
+
+    def generate(self, models, args):
+        """Play one self-play episode; returns None on env failure."""
+        moments = []
+        hidden = {p: models[p].init_hidden() for p in self.env.players()}
+
+        if self.env.reset():
+            return None
+
+        while not self.env.terminal():
+            moment = {
+                key: {p: None for p in self.env.players()}
+                for key in MOMENT_KEYS
+            }
+
+            turn_players = self.env.turns()
+            observers = self.env.observers()
+            for player in self.env.players():
+                if player not in turn_players + observers:
+                    continue
+                if (
+                    player not in turn_players
+                    and player in args["player"]
+                    and not self.args["observation"]
+                ):
+                    # trained non-turn players only observe when the
+                    # observation flag asks for RNN state upkeep
+                    continue
+
+                obs = self.env.observation(player)
+                outputs = models[player].inference(obs, hidden[player])
+                hidden[player] = outputs.get("hidden", None)
+
+                moment["observation"][player] = obs
+                value = outputs.get("value", None)
+                if value is not None:
+                    moment["value"][player] = np.ravel(
+                        np.asarray(value, np.float32)
+                    )
+
+                if player in turn_players:
+                    logits = outputs["policy"]
+                    legal = self.env.legal_actions(player)
+                    mask = np.full_like(logits, 1e32)
+                    mask[legal] = 0.0
+                    probs = softmax_np(logits - mask)
+                    action = random.choices(legal, weights=probs[legal])[0]
+
+                    moment["selected_prob"][player] = float(probs[action])
+                    moment["action_mask"][player] = mask
+                    moment["action"][player] = int(action)
+
+            if self.env.step(moment["action"]):
+                return None
+
+            reward = self.env.reward()
+            for player in self.env.players():
+                moment["reward"][player] = reward.get(player, None)
+
+            moment["turn"] = turn_players
+            moments.append(moment)
+
+        if not moments:
+            return None
+
+        # backward pass: discounted return per player
+        gamma = self.args["gamma"]
+        for player in self.env.players():
+            ret = 0.0
+            for m in reversed(moments):
+                ret = (m["reward"][player] or 0.0) + gamma * ret
+                m["return"][player] = ret
+
+        compress = self.args["compress_steps"]
+        return {
+            "args": args,
+            "steps": len(moments),
+            "outcome": self.env.outcome(),
+            "moment": [
+                bz2.compress(pickle.dumps(moments[i: i + compress]))
+                for i in range(0, len(moments), compress)
+            ],
+        }
+
+    def execute(self, models, args):
+        episode = self.generate(models, args)
+        if episode is None:
+            print("None episode in generation!")
+        return episode

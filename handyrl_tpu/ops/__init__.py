@@ -1,0 +1,7 @@
+from .targets import (
+    monte_carlo,
+    temporal_difference,
+    upgo,
+    vtrace,
+    compute_target,
+)

@@ -1,0 +1,276 @@
+"""Forward prediction and loss composition — the jitted learner math.
+
+Semantic parity with /root/reference/handyrl/train.py:128-268:
+  * feed-forward nets run one big flattened forward over (B*T*P, ...)
+    — MXU-friendly: a single large batched matmul/conv stream;
+  * recurrent nets run a ``lax.scan`` over time with observation-mask
+    hidden blending, turn-based hidden gathering, and gradient-free
+    burn-in (``stop_gradient`` per step — GroupNorm models have no
+    train/eval mode divergence, so burn-in needs no mode switch);
+  * losses: V-Trace/UPGO/TD/MC targets on detached values, importance
+    ratios clipped at 1, two-player zero-sum value symmetrization,
+    terminal outcome bootstrap, entropy regularization decayed by
+    episode progress.
+
+Everything here is pure and traced once per batch geometry.
+"""
+
+from typing import Any, Callable, Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .targets import compute_target
+
+CLIP_RHO = 1.0
+CLIP_C = 1.0
+
+
+class LossConfig(NamedTuple):
+    """Static (trace-time) training hyper-parameters."""
+
+    turn_based_training: bool
+    observation: bool
+    burn_in_steps: int
+    lambda_: float
+    gamma: float
+    policy_target: str
+    value_target: str
+    entropy_regularization: float
+    entropy_regularization_decay: float
+
+    @classmethod
+    def from_config(cls, cfg) -> "LossConfig":
+        return cls(
+            turn_based_training=bool(cfg["turn_based_training"]),
+            observation=bool(cfg["observation"]),
+            burn_in_steps=int(cfg["burn_in_steps"]),
+            lambda_=float(cfg["lambda"]),
+            gamma=float(cfg["gamma"]),
+            policy_target=str(cfg["policy_target"]),
+            value_target=str(cfg["value_target"]),
+            entropy_regularization=float(cfg["entropy_regularization"]),
+            entropy_regularization_decay=float(cfg["entropy_regularization_decay"]),
+        )
+
+
+def _flatten_lead(tree, n):
+    return jax.tree.map(
+        lambda a: a.reshape((-1,) + a.shape[n:]), tree
+    )
+
+
+def forward_prediction(apply_fn: Callable, params, hidden, batch,
+                       cfg: LossConfig) -> Dict[str, jnp.ndarray]:
+    """Run the net over a (B, T, P_in, ...) batch -> (B, T, P_in/P, ...).
+
+    ``hidden`` is the initial (B, P, ...) recurrent state or None.
+    """
+    observations = batch["observation"]
+    B, T, P_in = batch["action"].shape[:3]
+
+    if hidden is None:
+        obs_flat = _flatten_lead(observations, 3)  # (B*T*P_in, ...)
+        out = apply_fn(params, obs_flat, None)
+        outputs = {
+            k: v.reshape((B, T, P_in) + v.shape[1:])
+            for k, v in out.items()
+            if v is not None
+        }
+    else:
+        omask_full = batch["observation_mask"]  # (B, T, P, 1)
+        # seats the net was applied to this step: the single acting seat
+        # in turn-based mode, every player otherwise
+        P_model = 1 if (cfg.turn_based_training and not cfg.observation) \
+            else omask_full.shape[2]
+
+        def step(carry, xs):
+            hidden = carry
+            obs_t, omask_t, t = xs  # (B, P_in, ...), (B, P, 1), scalar
+
+            # zero hidden where the player did not observe (episode
+            # starts inside the window restart the recurrence)
+            def mask_like(h):
+                return omask_t.reshape(omask_t.shape[:2] + (1,) * (h.ndim - 2))
+
+            h_masked = jax.tree.map(lambda h: h * mask_like(h), hidden)
+            if cfg.turn_based_training and not cfg.observation:
+                # only the turn player's hidden is non-zero: the P-sum
+                # gathers it into the single acting seat
+                h_in = jax.tree.map(lambda h: h.sum(axis=1), h_masked)
+            else:
+                h_in = _flatten_lead(h_masked, 2)  # (B*P, ...)
+
+            obs_flat = _flatten_lead(obs_t, 2)  # (B*P_in, ...)
+            out = apply_fn(params, obs_flat, h_in)
+            out = {
+                k: v.reshape((B, P_in) + v.shape[1:]) if k != "hidden"
+                else v
+                for k, v in out.items()
+                if v is not None
+            }
+            next_hidden = out.pop("hidden")
+            next_hidden = jax.tree.map(
+                lambda h: h.reshape((B, P_model) + h.shape[1:]),
+                next_hidden,
+            )
+
+            # burn-in steps contribute no gradient
+            burn = t < cfg.burn_in_steps
+            out = jax.tree.map(
+                lambda v: jnp.where(burn, lax.stop_gradient(v), v), out
+            )
+            next_hidden = jax.tree.map(
+                lambda v: jnp.where(burn, lax.stop_gradient(v), v), next_hidden
+            )
+
+            # write the new hidden into observed seats only
+            new_hidden = jax.tree.map(
+                lambda h, nh: h * (1 - mask_like(h)) + nh * mask_like(h),
+                hidden,
+                next_hidden,
+            )
+            return new_hidden, out
+
+        xs = (
+            jax.tree.map(lambda a: jnp.moveaxis(a, 1, 0), observations),
+            jnp.moveaxis(omask_full, 1, 0),
+            jnp.arange(T),
+        )
+        _, outs = lax.scan(step, hidden, xs)
+        outputs = {k: jnp.moveaxis(v, 0, 1) for k, v in outs.items()}
+
+    # mask heads: policy by turn, scalar heads by observation
+    result = {}
+    for k, o in outputs.items():
+        if k == "policy":
+            o = o * batch["turn_mask"]  # may broadcast P_in -> P
+            if o.shape[2] > P_in:
+                # turn-alternating batch: collapse back to the acting seat
+                o = o.sum(axis=2, keepdims=True)
+            result[k] = o - batch["action_mask"]
+        else:
+            result[k] = o * batch["observation_mask"]
+    return result
+
+
+def _huber(x):
+    """Smooth-L1 with delta=1 (matches F.smooth_l1_loss)."""
+    absx = jnp.abs(x)
+    return jnp.where(absx < 1.0, 0.5 * x * x, absx - 0.5)
+
+
+def _masked_entropy(logits, axis=-1):
+    """Categorical entropy that is exact-zero-safe for -1e32 masked
+    logits (softmax underflows to exactly 0, and 0 * finite = 0)."""
+    lsm = jax.nn.log_softmax(logits, axis=axis)
+    p = jnp.exp(lsm)
+    return -jnp.sum(p * jnp.clip(lsm, -1e32, 0.0), axis=axis)
+
+
+def compose_losses(outputs, log_selected_policies, total_advantages,
+                   targets, batch, cfg: LossConfig):
+    """Combine policy / value / return / entropy losses (summed, not
+    averaged — the lr schedule normalizes by the data-count EMA)."""
+    tmasks = batch["turn_mask"]
+    omasks = batch["observation_mask"]
+
+    losses = {}
+    dcnt = tmasks.sum()
+
+    losses["p"] = (-log_selected_policies * total_advantages * tmasks).sum()
+    if "value" in outputs:
+        losses["v"] = (
+            ((outputs["value"] - targets["value"]) ** 2) * omasks
+        ).sum() / 2
+    if "return" in outputs:
+        losses["r"] = (
+            _huber(outputs["return"] - targets["return"]) * omasks
+        ).sum()
+
+    entropy = _masked_entropy(outputs["policy"]) * tmasks.sum(-1)  # (B,T,P)
+    losses["ent"] = entropy.sum()
+
+    base_loss = losses["p"] + losses.get("v", 0.0) + losses.get("r", 0.0)
+    decay_weight = 1.0 - batch["progress"] * (
+        1.0 - cfg.entropy_regularization_decay
+    )
+    entropy_loss = (entropy * decay_weight).sum() * -cfg.entropy_regularization
+    losses["total"] = base_loss + entropy_loss
+
+    return losses, dcnt
+
+
+def compute_loss(apply_fn: Callable, params, batch, hidden, cfg: LossConfig):
+    """Full forward + target computation + loss composition."""
+    outputs = forward_prediction(apply_fn, params, hidden, batch, cfg)
+    if cfg.burn_in_steps > 0:
+        b = cfg.burn_in_steps
+        batch = {
+            k: v[:, b:] if v.shape[1] > 1 else v for k, v in batch.items()
+            if k != "observation"
+        } | {"observation": batch["observation"]}
+        outputs = {k: v[:, b:] for k, v in outputs.items()}
+
+    actions = batch["action"]
+    emasks = batch["episode_mask"]
+    omasks = batch["observation_mask"]
+    value_target_masks, return_target_masks = omasks, omasks
+
+    log_selected_b = (
+        jnp.log(jnp.clip(batch["selected_prob"], 1e-16, 1.0)) * emasks
+    )
+    log_policy = jax.nn.log_softmax(outputs["policy"], axis=-1)
+    log_selected_t = (
+        jnp.take_along_axis(log_policy, actions, axis=-1) * emasks
+    )
+
+    # importance-sampling ratios (behavior -> target), clipped at 1
+    log_rhos = lax.stop_gradient(log_selected_t) - log_selected_b
+    rhos = jnp.exp(log_rhos)
+    clipped_rhos = jnp.clip(rhos, 0.0, CLIP_RHO)
+    cs = jnp.clip(rhos, 0.0, CLIP_C)
+
+    outputs_nograd = {k: lax.stop_gradient(v) for k, v in outputs.items()}
+
+    if "value" in outputs_nograd:
+        values_nograd = outputs_nograd["value"]
+        if cfg.turn_based_training and values_nograd.shape[2] == 2:
+            # two-player zero-sum: average own value with the negated
+            # opponent view wherever either observed
+            values_opp = -jnp.flip(values_nograd, axis=2)
+            omasks_opp = jnp.flip(omasks, axis=2)
+            values_nograd = (
+                values_nograd * omasks + values_opp * omasks_opp
+            ) / (omasks + omasks_opp + 1e-8)
+            value_target_masks = jnp.clip(omasks + omasks_opp, 0.0, 1.0)
+        # beyond the terminal step the target is the final outcome
+        outputs_nograd["value"] = (
+            values_nograd * emasks + batch["outcome"] * (1 - emasks)
+        )
+
+    targets, advantages = {}, {}
+    value_args = (
+        outputs_nograd.get("value", None), batch["outcome"], None,
+        cfg.lambda_, 1.0, clipped_rhos, cs, value_target_masks,
+    )
+    return_args = (
+        outputs_nograd.get("return", None), batch["return"], batch["reward"],
+        cfg.lambda_, cfg.gamma, clipped_rhos, cs, return_target_masks,
+    )
+
+    targets["value"], advantages["value"] = compute_target(
+        cfg.value_target, *value_args
+    )
+    targets["return"], advantages["return"] = compute_target(
+        cfg.value_target, *return_args
+    )
+    if cfg.policy_target != cfg.value_target:
+        _, advantages["value"] = compute_target(cfg.policy_target, *value_args)
+        _, advantages["return"] = compute_target(cfg.policy_target, *return_args)
+
+    total_advantages = clipped_rhos * sum(advantages.values())
+    return compose_losses(
+        outputs, log_selected_t, total_advantages, targets, batch, cfg
+    )

@@ -1,8 +1,6 @@
 from .tree import (
     tree_map,
-    tree_map2,
     tree_stack,
-    tree_zeros_like,
     stack_time_player,
     softmax_np,
 )

@@ -25,19 +25,6 @@ def tree_map(fn, x):
     return fn(x)
 
 
-def tree_map2(fn, x, y):
-    """Two-structure zip-map; structure is taken from ``x``."""
-    if isinstance(x, dict):
-        return {k: tree_map2(fn, v, y[k]) for k, v in x.items()}
-    if isinstance(x, (list, tuple)):
-        return type(x)(tree_map2(fn, v, y[i]) for i, v in enumerate(x))
-    return fn(x, y)
-
-
-def tree_zeros_like(x):
-    return tree_map(lambda a: None if a is None else np.zeros_like(a), x)
-
-
 def tree_stack(trees, axis=0):
     """Stack a list of identically-structured trees leaf-wise."""
     first = trees[0]

@@ -21,7 +21,7 @@ ENVS = [
 
 
 def _make(path):
-    module = importlib.import_module(path)
+    module = pytest.importorskip(path)
     return module.Environment()
 
 
