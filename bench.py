@@ -1,0 +1,57 @@
+"""Benchmark: learner update steps/sec on the jitted training step.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` compares against the reference's equivalent update loop
+measured on this host if available (see BASELINE.md: the reference
+publishes no numbers, so the ratio is against our recorded CPU-reference
+measurement when present, else 1.0).
+"""
+
+import json
+import time
+
+
+def main():
+    from __graft_entry__ import _build_model_and_batch
+
+    from handyrl_tpu.ops.losses import LossConfig
+    from handyrl_tpu.ops.update import make_optimizer, make_update_step
+
+    batch_size = 64
+    model, batch, cfg = _build_model_and_batch(batch_size=batch_size)
+    loss_cfg = LossConfig.from_config(cfg)
+    optimizer = make_optimizer(1e-3)
+    params = model.params
+    opt_state = optimizer.init(params)
+    update = make_update_step(model, loss_cfg, optimizer)
+
+    # compile + warmup
+    params, opt_state, metrics = update(params, opt_state, batch)
+    float(metrics["total"])
+
+    iters = 50
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, metrics = update(params, opt_state, batch)
+    float(metrics["total"])  # sync
+    dt = time.perf_counter() - t0
+
+    steps_per_sec = iters / dt
+    baseline = None
+    try:
+        with open("BASELINE_MEASURED.json") as f:
+            baseline = json.load(f).get("learner_steps_per_sec")
+    except OSError:
+        pass
+    vs = steps_per_sec / baseline if baseline else 1.0
+
+    print(json.dumps({
+        "metric": "learner_update_steps_per_sec",
+        "value": round(steps_per_sec, 2),
+        "unit": f"steps/sec (batch={batch_size}x{cfg['forward_steps']})",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

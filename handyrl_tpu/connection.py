@@ -1,0 +1,285 @@
+"""Control-plane messaging: framed pickle over sockets and pipes.
+
+This is the learner<->actor transport (role parity with
+/root/reference/handyrl/connection.py:14-224).  It is deliberately NOT
+the data plane: device-to-device traffic (gradient reduction, sharded
+batches) rides XLA collectives over ICI inside jitted programs (see
+handyrl_tpu.parallel); this module only moves control messages and
+compressed trajectories between CPU processes/machines.
+
+Wire format: 4-byte big-endian length + pickle payload.  Large payloads
+are sent in chunks so a slow peer cannot wedge the sender's buffer.
+"""
+
+import io
+import multiprocessing as mp
+import pickle
+import queue
+import random
+import socket
+import struct
+import threading
+import time
+from typing import Any, Callable, Dict, Iterable, Optional
+
+CHUNK = 1 << 14  # 16 KiB send granularity
+
+
+def send_recv(conn, sdata):
+    """One request/reply round trip."""
+    conn.send(sdata)
+    return conn.recv()
+
+
+class FramedConnection:
+    """Length-prefixed pickle messaging over a stream socket.
+
+    Same duck-type as ``mp.Pipe`` connections (``send``/``recv``/
+    ``close``/``fileno``) so every layer above can hold either.
+    """
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+
+    def fileno(self):
+        return self.sock.fileno()
+
+    def close(self):
+        if self.sock is not None:
+            self.sock.close()
+            self.sock = None
+
+    def send(self, data: Any):
+        payload = pickle.dumps(data, protocol=pickle.HIGHEST_PROTOCOL)
+        header = struct.pack("!I", len(payload))
+        buf = memoryview(header + payload)
+        while buf:
+            n = self.sock.send(buf[:CHUNK])
+            buf = buf[n:]
+
+    def _recv_exact(self, n: int) -> bytes:
+        chunks = io.BytesIO()
+        remaining = n
+        while remaining:
+            data = self.sock.recv(remaining)
+            if not data:
+                raise ConnectionResetError("peer closed")
+            chunks.write(data)
+            remaining -= len(data)
+        return chunks.getvalue()
+
+    def recv(self) -> Any:
+        (length,) = struct.unpack("!I", self._recv_exact(4))
+        return pickle.loads(self._recv_exact(length))
+
+
+# -- TCP helpers --------------------------------------------------------
+
+def open_socket_connection(address: str, port: int, reuse=False):
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.setsockopt(
+        socket.SOL_SOCKET, socket.SO_REUSEADDR,
+        sock.getsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR) | 1,
+    )
+    sock.connect((address, port))
+    return FramedConnection(sock)
+
+
+def accept_socket_connections(port: int, timeout=None, maxsize=1024):
+    """Generator of connections; yields None on accept timeout so the
+    caller's loop can check for shutdown."""
+    server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    server.bind(("", port))
+    server.listen(maxsize)
+    server.settimeout(timeout)
+    cnt = 0
+    while cnt < maxsize:
+        try:
+            sock, _ = server.accept()
+            yield FramedConnection(sock)
+            cnt += 1
+        except socket.timeout:
+            yield None
+
+
+# -- multiprocessing fan-out --------------------------------------------
+
+# Child processes are SPAWNED, not forked: the parent owns a live TPU
+# client (PJRT handles do not survive fork), so children start from a
+# fresh interpreter and pin themselves to the CPU backend.
+_mp = mp.get_context("spawn")
+
+
+def force_cpu_jax():
+    """Pin this process's JAX to CPU (actor/batcher processes must not
+    touch the learner's TPU).  Call before any jax usage in a child."""
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def open_multiprocessing_connections(num_procs: int,
+                                     target: Callable,
+                                     args_func: Callable[[int], tuple]):
+    """Spawn ``num_procs`` daemon processes, each holding one end of a
+    duplex pipe; returns the parent-side connections."""
+    parent_conns = []
+    for i in range(num_procs):
+        parent, child = _mp.Pipe(duplex=True)
+        proc = _mp.Process(
+            target=target, args=(child,) + args_func(i), daemon=True
+        )
+        proc.start()
+        child.close()
+        parent_conns.append(parent)
+    return parent_conns
+
+
+class MultiProcessJobExecutor:
+    """Farm (send job -> recv result) over worker processes.
+
+    ``func(conn, *args)`` runs in each child and is expected to loop
+    ``recv -> work -> send``.  The parent pushes jobs round-robin from
+    ``send_generator`` whenever a worker's slot frees, keeping
+    ``num_receivers`` threads draining results into a bounded queue —
+    the same overlap structure the reference uses for its batcher farm
+    (/root/reference/handyrl/connection.py:133-173).
+    """
+
+    def __init__(self, func, send_generator, num_workers,
+                 postprocess=None, out_maxsize: int = 8,
+                 args_func: Callable[[int], tuple] = lambda i: ()):
+        self.send_generator = send_generator
+        self.postprocess = postprocess
+        self.conns = open_multiprocessing_connections(
+            num_workers, func, args_func
+        )
+        self.waiting_conns = queue.Queue()
+        for conn in self.conns:
+            self.waiting_conns.put(conn)
+        self.output_queue = queue.Queue(maxsize=out_maxsize)
+        self.shutdown_flag = False
+        self.threads = []
+
+    def shutdown(self):
+        self.shutdown_flag = True
+
+    def recv(self):
+        return self.output_queue.get()
+
+    def start(self):
+        self.threads.append(
+            threading.Thread(target=self._sender, daemon=True))
+        self.threads.append(
+            threading.Thread(target=self._receiver, daemon=True))
+        for t in self.threads:
+            t.start()
+
+    def _sender(self):
+        while not self.shutdown_flag:
+            conn = self.waiting_conns.get()
+            conn.send(next(self.send_generator))
+
+    def _receiver(self):
+        while not self.shutdown_flag:
+            ready = mp.connection.wait(self.conns, timeout=0.3)
+            for conn in ready:
+                try:
+                    data = conn.recv()
+                except EOFError:
+                    continue
+                self.waiting_conns.put(conn)
+                if self.postprocess is not None:
+                    data = self.postprocess(data)
+                self.output_queue.put(data)
+
+
+class QueueCommunicator:
+    """Async request hub over a mutable set of connections.
+
+    Receives from every registered connection into ``input_queue`` as
+    ``(conn, data)`` pairs; ``send_queue`` drains in a writer thread.
+    Dead peers (reset/EOF) are dropped — workers are elastic, they can
+    connect and vanish at any time (parity with
+    /root/reference/handyrl/connection.py:176-224 and the elastic-join
+    design in /root/reference/docs/large_scale_training.md:34).
+    """
+
+    def __init__(self, conns: Iterable = ()):
+        self.input_queue = queue.Queue(maxsize=256)
+        self.output_queue = queue.Queue(maxsize=256)
+        self.conns: Dict[Any, bool] = {}
+        self._lock = threading.Lock()
+        for conn in conns:
+            self.add_connection(conn)
+        self.shutdown_flag = False
+        self.threads = [
+            threading.Thread(target=self._send_loop, daemon=True),
+            threading.Thread(target=self._recv_loop, daemon=True),
+        ]
+        for t in self.threads:
+            t.start()
+
+    def shutdown(self):
+        self.shutdown_flag = True
+
+    def connection_count(self):
+        return len(self.conns)
+
+    def recv(self, timeout=None):
+        return self.input_queue.get(timeout=timeout)
+
+    def send(self, conn, send_data):
+        self.output_queue.put((conn, send_data))
+
+    def add_connection(self, conn):
+        with self._lock:
+            self.conns[conn] = True
+
+    def disconnect(self, conn):
+        with self._lock:
+            self.conns.pop(conn, None)
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def _send_loop(self):
+        while not self.shutdown_flag:
+            try:
+                conn, send_data = self.output_queue.get(timeout=0.3)
+            except queue.Empty:
+                continue
+            try:
+                conn.send(send_data)
+            except (ConnectionResetError, BrokenPipeError, OSError):
+                self.disconnect(conn)
+
+    def _recv_loop(self):
+        while not self.shutdown_flag:
+            with self._lock:
+                conns = list(self.conns)
+            if not conns:
+                time.sleep(0.1)
+                continue
+            try:
+                ready = mp.connection.wait(conns, timeout=0.3)
+            except OSError:
+                ready = []
+            for conn in ready:
+                try:
+                    data = conn.recv()
+                except (ConnectionResetError, BrokenPipeError, EOFError,
+                        OSError):
+                    self.disconnect(conn)
+                    continue
+                while not self.shutdown_flag:
+                    try:
+                        self.input_queue.put((conn, data), timeout=0.3)
+                        break
+                    except queue.Full:
+                        continue

@@ -1,0 +1,387 @@
+"""Evaluation: online eval matches, offline eval driver, network battles.
+
+Role parity with /root/reference/handyrl/evaluation.py:32-436 — the
+online Evaluator used by workers during training, the multiprocess
+offline driver behind ``--eval`` (with first/second seat equalization
+for two-player games), and the network battle mode where a server hosts
+the env and remote clients drive agents over TCP via the env's
+``diff_info``/``update`` delta-sync protocol.
+"""
+
+import multiprocessing as mp
+import random
+import time
+
+from .agent import Agent, RandomAgent, RuleBasedAgent
+from .connection import (
+    accept_socket_connections,
+    open_socket_connection,
+)
+from .environment import make_env, prepare_env
+from .models import TPUModel
+
+NETWORK_PORT = 9876
+
+
+class NetworkAgentClient:
+    """Client side of a network battle: owns the agent and a mirror env,
+    executing RPC verbs sent by the server."""
+
+    def __init__(self, agent, env, conn):
+        self.conn = conn
+        self.agent = agent
+        self.env = env
+
+    def run(self):
+        while True:
+            try:
+                command, args = self.conn.recv()
+            except (ConnectionResetError, EOFError):
+                break
+            if command == "quit":
+                break
+            elif command == "outcome":
+                print(f"outcome = {args[0]}")
+            elif hasattr(self.agent, command):
+                ret = getattr(self.agent, command)(self.env, *args, show=True)
+                if command == "action":
+                    player = args[0]
+                    ret = self.env.action2str(ret, player)
+            else:
+                ret = getattr(self.env, command)(*args)
+                if command == "update":
+                    print(self.env)
+            self.conn.send(ret)
+
+
+class NetworkAgent:
+    """Server-side proxy: forwards verbs to a remote client agent."""
+
+    def __init__(self, conn):
+        self.conn = conn
+
+    def update(self, data, reset):
+        return self._send("update", [data, reset])
+
+    def outcome(self, outcome):
+        return self._send("outcome", [outcome])
+
+    def action(self, player):
+        return self._send("action", [player])
+
+    def observe(self, player):
+        return self._send("observe", [player])
+
+    def _send(self, command, args):
+        self.conn.send((command, args))
+        return self.conn.recv()
+
+
+def exec_match(env, agents, critic=None, show=False, game_args={}):
+    """One match on a shared env instance; returns per-player outcome."""
+    if env.reset(game_args):
+        return None
+    for agent in agents.values():
+        agent.reset(env, show=show)
+    while not env.terminal():
+        if show:
+            print(env)
+        turn_players = env.turns()
+        observers = env.observers()
+        actions = {}
+        for p, agent in agents.items():
+            if p in turn_players:
+                actions[p] = agent.action(env, p, show=show)
+            elif p in observers:
+                agent.observe(env, p, show=show)
+        if env.step(actions):
+            return None
+        if show and critic is not None:
+            print(f"cv = {critic.observe(env, None, show=False)}")
+    if show:
+        print(env)
+        print(f"final outcome = {env.outcome()}")
+    return env.outcome()
+
+
+def exec_network_match(env, network_agents, critic=None, game_args={}):
+    """One match where agents live on remote clients, kept in sync by
+    the env's diff protocol."""
+    if env.reset(game_args):
+        return None
+    for p, agent in network_agents.items():
+        info = env.diff_info(p)
+        agent.update(info, True)
+    while not env.terminal():
+        turn_players = env.turns()
+        observers = env.observers()
+        actions = {}
+        for p, agent in network_agents.items():
+            if p in turn_players:
+                action_str = agent.action(p)
+                actions[p] = env.str2action(action_str, p)
+            elif p in observers:
+                agent.observe(p)
+        if env.step(actions):
+            return None
+        for p, agent in network_agents.items():
+            info = env.diff_info(p)
+            agent.update(info, False)
+    outcome = env.outcome()
+    for p, agent in network_agents.items():
+        agent.outcome(outcome[p])
+    return outcome
+
+
+def build_agent(raw, env=None):
+    """Instantiate a named opponent: 'random', 'rulebase[-key]'."""
+    if raw == "random":
+        return RandomAgent()
+    if raw.startswith("rulebase"):
+        key = raw.split("-")[1] if "-" in raw else None
+        return RuleBasedAgent(key)
+    return None
+
+
+class Evaluator:
+    """Online evaluation during training: trained model vs configured
+    opponent pool (default 'random')."""
+
+    def __init__(self, env, args):
+        self.env = env
+        self.args = args
+        self.opponent = args.get("eval", {}).get("opponent", ["random"])
+        if not isinstance(self.opponent, list):
+            self.opponent = [self.opponent]
+
+    def execute(self, models, args):
+        opponents = self.opponent
+        opponent = random.choice(opponents) if opponents else "random"
+        agents = {}
+        for p, model in models.items():
+            if model is None:
+                agents[p] = build_agent(opponent, self.env) or RandomAgent()
+            else:
+                agents[p] = Agent(model, observation=self.args["observation"])
+        outcome = exec_match(self.env, agents)
+        if outcome is None:
+            print("None episode in evaluation!")
+            return None
+        return {"args": args, "result": outcome, "opponent": opponent}
+
+
+def wp_func(results):
+    """Win rate over an outcome histogram (draws count half)."""
+    games = sum(results.values())
+    if games == 0:
+        return 0.0
+    win = sum(n for r, n in results.items() if r > 0)
+    draw = sum(n for r, n in results.items() if r == 0)
+    return (win + draw / 2) / games
+
+
+def eval_process_mp_child(agents, critic, env_args, index, in_queue, out_queue,
+                          seed, show=False):
+    from .connection import force_cpu_jax
+
+    force_cpu_jax()
+    random.seed(seed + index)
+    env = make_env({**env_args, "id": index})
+    while True:
+        args = in_queue.get()
+        if args is None:
+            break
+        g, agent_ids, pat_idx, game_args = args
+        print(f"*** Game {g} ***")
+        agent_map = {
+            env.players()[p]: agents[ai] for p, ai in enumerate(agent_ids)
+        }
+        if isinstance(list(agent_map.values())[0], NetworkAgent):
+            outcome = exec_network_match(env, agent_map, critic,
+                                         game_args=game_args)
+        else:
+            outcome = exec_match(env, agent_map, critic, show=show,
+                                 game_args=game_args)
+        out_queue.put((pat_idx, agent_ids, outcome))
+    out_queue.put(None)
+
+
+def evaluate_mp(env, agents, critic, env_args, args_patterns, num_process,
+                num_games, seed):
+    """Offline evaluation farm: ``num_process`` processes play
+    ``num_games`` per pattern; two-player seats are equalized."""
+    from .connection import _mp
+
+    in_queue, out_queue = _mp.Queue(), _mp.Queue()
+    args_cnt = 0
+    total_results, result_map = [{} for _ in agents], [{} for _ in agents]
+    print("total games = %d" % (len(args_patterns) * num_games))
+    time.sleep(0.1)
+    for pat_name, game_args in args_patterns.items():
+        for i in range(num_games):
+            if len(agents) == 2:
+                # first/second seat equalization
+                first_agent = 0 if i < (num_games + 1) // 2 else 1
+                seat = "first" if first_agent == 0 else "second"
+                tmp_pat_idx = f"{pat_name}_{seat}"
+                agent_ids = [first_agent, 1 - first_agent]
+            else:
+                tmp_pat_idx = pat_name
+                agent_ids = random.sample(
+                    list(range(len(agents))), len(agents))
+            in_queue.put((args_cnt, agent_ids, tmp_pat_idx, game_args))
+            args_cnt += 1
+
+    network_mode = agents[0] is None
+    if network_mode:  # network battle mode
+        agents = network_match_acception(
+            num_process, env_args, len(agents), NETWORK_PORT)
+    else:
+        agents = [agents] * num_process
+
+    for i in range(num_process):
+        in_queue.put(None)
+        args = (agents[i], critic, env_args, i, in_queue, out_queue, seed)
+        if num_process > 1:
+            _mp.Process(target=eval_process_mp_child, args=args,
+                        daemon=True).start()
+            if network_mode:
+                for agent in agents[i]:
+                    agent.conn.close()
+        else:
+            eval_process_mp_child(*args, show=True)
+
+    finished_cnt = 0
+    while finished_cnt < num_process:
+        ret = out_queue.get()
+        if ret is None:
+            finished_cnt += 1
+            continue
+        pat_idx, agent_ids, outcome = ret
+        if outcome is not None:
+            for idx, p in enumerate(env.players()):
+                agent_id = agent_ids[idx]
+                oc = outcome[p]
+                result_map[agent_id].setdefault(pat_idx, {})
+                result_map[agent_id][pat_idx][oc] = (
+                    result_map[agent_id][pat_idx].get(oc, 0) + 1)
+                total_results[agent_id][oc] = (
+                    total_results[agent_id].get(oc, 0) + 1)
+
+    for idx, result in enumerate(result_map):
+        print(f"agent {idx}")
+        for pat_idx, results in result.items():
+            print(f"    pattern {pat_idx}: "
+                  f"win rate = {wp_func(results):.3f} "
+                  f"({sum(results.values())} games)")
+    for idx, results in enumerate(total_results):
+        print(f"agent {idx}: win rate = {wp_func(results):.3f}")
+
+
+def network_match_acception(n, env_args, num_agents, port):
+    """Accept ``n * num_agents`` client connections and group them into
+    per-match agent lists."""
+    waiting_conns = []
+    accepted_conns = []
+
+    for conn in accept_socket_connections(port):
+        if conn is None:
+            continue
+        waiting_conns.append(conn)
+        if len(waiting_conns) == num_agents:
+            conn = waiting_conns[0]
+            accepted_conns.append(conn)
+            waiting_conns = waiting_conns[1:]
+            conn.send(env_args)  # send accepted env args
+
+        if len(accepted_conns) >= n * num_agents:
+            break
+
+    agents_list = [
+        [NetworkAgent(accepted_conns[i * num_agents + j])
+         for j in range(num_agents)]
+        for i in range(n)
+    ]
+    return agents_list
+
+
+def client_mp_child(env_args, model_path, conn):
+    env = make_env(env_args)
+    model = load_model(model_path, env)
+    NetworkAgentClient(Agent(model), env, conn).run()
+
+
+def load_model(model_path, env):
+    """Load a saved checkpoint into a TPUModel for evaluation."""
+    import pickle
+
+    model = TPUModel(env.net())
+    with open(model_path, "rb") as f:
+        blob = f.read()
+    state = pickle.loads(blob)
+    params = state["params"] if isinstance(state, dict) and "params" in state \
+        else state
+    model.params = params
+    return model
+
+
+def eval_main(args, argv):
+    env_args = args["env_args"]
+    prepare_env(env_args)
+    env = make_env(env_args)
+
+    model_path = argv[0] if len(argv) >= 1 else "models/latest.ckpt"
+    num_games = int(argv[1]) if len(argv) >= 2 else 100
+    num_process = int(argv[2]) if len(argv) >= 3 else 1
+
+    def resolve_agent(raw):
+        agent = build_agent(raw, env)
+        if agent is None:
+            model = load_model(raw, env)
+            agent = Agent(model)
+        return agent
+
+    agent1 = resolve_agent(model_path)
+    critic = None
+    print(f"evaluated files = {model_path}")
+
+    seed = random.randrange(1 << 31)
+    print(f"seed = {seed}")
+    opponent = args.get("eval_args", {}).get("opponent", "random")
+    agents = [agent1] + [
+        build_agent(opponent, env) or RandomAgent()
+        for _ in range(len(env.players()) - 1)
+    ]
+    evaluate_mp(env, agents, critic, env_args, {"default": {}},
+                num_process, num_games, seed)
+
+
+def eval_server_main(args, argv):
+    print("network match server mode")
+    env_args = args["env_args"]
+    prepare_env(env_args)
+    env = make_env(env_args)
+
+    num_games = int(argv[0]) if len(argv) >= 1 else 100
+    num_process = int(argv[1]) if len(argv) >= 2 else 1
+
+    seed = random.randrange(1 << 31)
+    print(f"seed = {seed}")
+    evaluate_mp(env, [None] * len(env.players()), None, env_args,
+                {"default": {}}, num_process, num_games, seed)
+
+
+def eval_client_main(args, argv):
+    print("network match client mode")
+    while True:
+        try:
+            host = argv[1] if len(argv) >= 2 else "localhost"
+            conn = open_socket_connection(host, NETWORK_PORT)
+            env_args = conn.recv()
+        except EOFError:
+            break
+
+        model_path = argv[0] if len(argv) >= 1 else "models/latest.ckpt"
+        mp.Process(target=client_mp_child,
+                   args=(env_args, model_path, conn), daemon=True).start()
+        conn.close()

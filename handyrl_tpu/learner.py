@@ -1,0 +1,494 @@
+"""The learner: conductor server, training thread, batcher farm.
+
+Role parity with /root/reference/handyrl/train.py:270-644, re-designed
+TPU-first:
+
+  * the Trainer's per-batch Python work is ONE jitted ``update_step``
+    (grad + clip + Adam fused into a single XLA program); params and
+    optimizer state live on device the whole epoch and are donated
+    across steps — the host only touches them to snapshot an epoch;
+  * under a device mesh the same step runs SPMD with the batch sharded
+    over ``dp`` and XLA all-reducing gradients over ICI
+    (handyrl_tpu.parallel) — replacing ``nn.DataParallel``;
+  * batch assembly stays on CPU in batcher processes; finished batches
+    stream through a device prefetch so H2D copy overlaps compute;
+  * metrics accumulate on device and sync once per epoch, keeping the
+    hot loop free of host round trips;
+  * the epoch lr anneal (3e-8 * data_count_ema / (1 + steps*1e-5),
+    reference train.py:383-385) pokes an injected optax hyperparameter
+    — no recompile.
+
+The stdout log format (``updated model(N)``, ``epoch N``, ``win rate``,
+``loss = ...``, ``generation stats``) matches the reference exactly:
+the plot scripts parse these prefixes, so the format is a public API
+(/root/reference/scripts/win_rate_plot.py:33-51).
+"""
+
+import json
+import os
+import pickle
+import queue
+import random
+import threading
+import time
+from collections import deque
+
+import jax
+import numpy as np
+
+try:
+    import psutil
+except ImportError:  # pragma: no cover
+    psutil = None
+
+from .batch import make_batch
+from .connection import MultiProcessJobExecutor
+from .environment import make_env, prepare_env
+from .models import TPUModel, snapshot_params
+from .ops.losses import LossConfig
+from .ops.update import (
+    DEFAULT_LR,
+    make_optimizer,
+    make_update_step,
+    set_learning_rate,
+)
+from .worker import WorkerCluster, WorkerServer
+
+
+def _models_dir():
+    return "models"
+
+
+def model_path(model_id):
+    return os.path.join(_models_dir(), f"{model_id}.ckpt")
+
+
+def latest_model_path():
+    return os.path.join(_models_dir(), "latest.ckpt")
+
+
+def _batch_worker(conn, bid, cfg):
+    """Batcher child process: decompress + assemble numpy batches."""
+    from .connection import force_cpu_jax
+
+    force_cpu_jax()
+    print(f"started batcher {bid}")
+    try:
+        while True:
+            episodes = conn.recv()
+            batch = make_batch(episodes, cfg)
+            conn.send(batch)
+    except (ConnectionResetError, BrokenPipeError, EOFError, OSError):
+        pass  # learner is gone: exit quietly
+
+
+class Batcher:
+    """Parallel batch construction over ``num_batchers`` processes.
+
+    The parent samples episode windows (recency-biased) and ships them
+    to child processes that decompress + assemble fixed-shape numpy
+    batches (reference train.py:271-319)."""
+
+    def __init__(self, args, episodes):
+        self.args = args
+        self.episodes = episodes
+        # children only need the batch-geometry keys, not the env
+        cfg = {k: args[k] for k in (
+            "turn_based_training", "observation", "forward_steps",
+            "burn_in_steps", "compress_steps", "lambda",
+        ) if k in args}
+        self.executor = MultiProcessJobExecutor(
+            _batch_worker, self._selector(), self.args["num_batchers"],
+            args_func=lambda i: (i, cfg),
+        )
+
+    def _selector(self):
+        while True:
+            yield [self.select_episode()
+                   for _ in range(self.args["batch_size"])]
+
+    def run(self):
+        self.executor.start()
+
+    def select_episode(self):
+        """Recency-biased sampling: triangular acceptance over buffer
+        index, then a random training window with burn-in backoff and
+        bz2-block slicing (reference train.py:292-316)."""
+        while True:
+            ep_count = min(len(self.episodes), self.args["maximum_episodes"])
+            ep_idx = random.randrange(ep_count)
+            accept_rate = 1 - (ep_count - 1 - ep_idx) / ep_count
+            if random.random() >= accept_rate:
+                continue
+            try:
+                ep = self.episodes[ep_idx]
+                break
+            except IndexError:
+                continue
+        turn_candidates = 1 + max(
+            0, ep["steps"] - self.args["forward_steps"])
+        train_st = random.randrange(turn_candidates)
+        st = max(0, train_st - self.args["burn_in_steps"])
+        ed = min(train_st + self.args["forward_steps"], ep["steps"])
+        cmp = self.args["compress_steps"]
+        st_block, ed_block = st // cmp, (ed - 1) // cmp + 1
+        return {
+            "args": ep["args"], "outcome": ep["outcome"],
+            "moment": ep["moment"][st_block:ed_block],
+            "base": st_block * cmp,
+            "start": st, "end": ed, "train_start": train_st,
+            "total": ep["steps"],
+        }
+
+    def batch(self):
+        return self.executor.recv()
+
+    def shutdown(self):
+        self.executor.shutdown()
+
+
+class Trainer:
+    """Owns device state (params + optimizer) and the jitted step."""
+
+    def __init__(self, args, model: TPUModel):
+        self.episodes = deque()
+        self.args = args
+        self.model = model
+        self.loss_cfg = LossConfig.from_config(args)
+        self.default_lr = DEFAULT_LR
+        self.data_cnt_ema = args["batch_size"] * args["forward_steps"]
+        self.num_params = len(jax.tree.leaves(model.params or {}))
+        self.steps = 0
+        self.update_flag = False
+        self.update_queue = queue.Queue(maxsize=1)
+        self.batcher = Batcher(self.args, self.episodes)
+
+        if self.num_params > 0:
+            self.optimizer = make_optimizer(
+                self.default_lr * self.data_cnt_ema)
+            self.params = model.params
+            self.opt_state = self.optimizer.init(self.params)
+            self.update_step = self._build_update_step()
+        else:
+            self.optimizer = None
+
+    def _build_update_step(self):
+        mesh_cfg = self.args.get("mesh") or {}
+        if mesh_cfg and any(int(v) > 1 for v in mesh_cfg.values()):
+            from .parallel import MeshSpec, make_mesh, make_sharded_update_step
+
+            mesh = make_mesh(MeshSpec.from_config(mesh_cfg))
+            return make_sharded_update_step(
+                self.model, self.loss_cfg, self.optimizer, mesh, self.params
+            )
+        return make_update_step(self.model, self.loss_cfg, self.optimizer)
+
+    def update(self):
+        """Called by the Learner: finish the epoch, get a snapshot."""
+        self.update_flag = True
+        model, steps = self.update_queue.get()
+        return model, steps
+
+    def train(self):
+        if self.optimizer is None:  # non-parametric model
+            time.sleep(0.1)
+            return self.model
+
+        batch_cnt = 0
+        metric_acc = []
+
+        while batch_cnt == 0 or not self.update_flag:
+            batch = self.batcher.batch()
+            self.params, self.opt_state, metrics = self.update_step(
+                self.params, self.opt_state, batch)
+            # keep metrics on device; sync once per epoch
+            metric_acc.append(metrics)
+            batch_cnt += 1
+            self.steps += 1
+
+        data_cnt = sum(float(m["dcnt"]) for m in metric_acc)
+        loss_sum = {}
+        for m in metric_acc:
+            for k in ("p", "v", "r", "ent", "total"):
+                if k in m:
+                    loss_sum[k] = loss_sum.get(k, 0.0) + float(m[k])
+
+        print("loss = %s" % " ".join(
+            [k + ":" + "%.3f" % (l / data_cnt) for k, l in loss_sum.items()]))
+
+        self.data_cnt_ema = (
+            self.data_cnt_ema * 0.8 + data_cnt / (1e-2 + batch_cnt) * 0.2)
+        lr = self.default_lr * self.data_cnt_ema / (1 + self.steps * 1e-5)
+        self.opt_state = set_learning_rate(self.opt_state, lr)
+
+        # snapshot: device -> host once per epoch
+        snapshot = TPUModel(self.model.module)
+        snapshot.params = jax.tree.map(np.asarray, self.params)
+        self.last_metrics = {k: l / data_cnt for k, l in loss_sum.items()}
+        return snapshot
+
+    def run(self):
+        print("waiting training")
+        while len(self.episodes) < self.args["minimum_episodes"]:
+            time.sleep(1)
+        if self.optimizer is not None:
+            self.batcher.run()
+            print("started training")
+        while True:
+            model = self.train()
+            self.update_flag = False
+            self.update_queue.put((model, self.steps))
+
+
+class Learner:
+    """Central conductor: owns the replay buffer, serves worker
+    requests, reports stats, and checkpoints every epoch."""
+
+    def __init__(self, args, net=None, remote=False):
+        from .config import Config
+
+        cfg = args if isinstance(args, Config) else Config.from_dict(args)
+        train_args = cfg.train_args.to_dict()
+        env_args = dict(cfg.env_args)
+        train_args["env"] = env_args
+        self.args = train_args
+        random.seed(self.args["seed"])
+
+        self.env = make_env(env_args)
+        eval_modify_rate = (
+            self.args["update_episodes"] ** 0.85
+        ) / self.args["update_episodes"]
+        self.eval_rate = max(self.args["eval_rate"], eval_modify_rate)
+        self.shutdown_flag = False
+        self.flags = set()
+
+        # trained datum
+        self.model_epoch = self.args["restart_epoch"]
+        if net is not None:
+            self.model = net if isinstance(net, TPUModel) else TPUModel(net)
+        else:
+            self.model = TPUModel(self.env.net())
+        if self.model.params is None:
+            self.env.reset()
+            obs = self.env.observation(self.env.players()[0])
+            self.model.init_params(obs, seed=self.args["seed"])
+        if self.model_epoch > 0:
+            with open(model_path(self.model_epoch), "rb") as f:
+                self.model.params = pickle.load(f)["params"]
+
+        # generated datum
+        self.generation_results = {}
+        self.num_episodes = 0
+        self.num_returned_episodes = 0
+
+        # evaluated datum
+        self.results = {}
+        self.results_per_opponent = {}
+        self.num_results = 0
+
+        self.worker = WorkerServer(self.args) if remote \
+            else WorkerCluster(self.args)
+        self.trainer = Trainer(self.args, self.model)
+        self.metrics_path = self.args.get("metrics_path") or ""
+
+    # -- checkpointing ----------------------------------------------
+    def update_model(self, model, steps):
+        print("updated model(%d)" % steps)
+        self.model_epoch += 1
+        self.model = model
+        os.makedirs(_models_dir(), exist_ok=True)
+        state = {"params": model.params, "steps": steps,
+                 "epoch": self.model_epoch}
+        with open(model_path(self.model_epoch), "wb") as f:
+            pickle.dump(state, f)
+        with open(latest_model_path(), "wb") as f:
+            pickle.dump(state, f)
+
+    # -- episode / result intake ------------------------------------
+    def feed_episodes(self, episodes):
+        for episode in episodes:
+            if episode is None:
+                continue
+            for p in episode["args"]["player"]:
+                model_id = episode["args"]["model_id"][p]
+                outcome = episode["outcome"][p]
+                n, r, r2 = self.generation_results.get(model_id, (0, 0, 0))
+                self.generation_results[model_id] = (
+                    n + 1, r + outcome, r2 + outcome ** 2)
+            self.num_returned_episodes += 1
+            if self.num_returned_episodes % 100 == 0:
+                print(self.num_returned_episodes, end=" ", flush=True)
+
+        self.trainer.episodes.extend(
+            [e for e in episodes if e is not None])
+
+        # RAM guard: shrink the buffer target under memory pressure
+        mem_percent = psutil.virtual_memory().percent if psutil else 0.0
+        mem_ok = mem_percent <= 95
+        maximum_episodes = (
+            self.args["maximum_episodes"] if mem_ok
+            else int(len(self.trainer.episodes) * 95 / mem_percent))
+        if not mem_ok and "memory_over" not in self.flags:
+            import warnings
+
+            warnings.warn(
+                "memory usage %.1f%% with buffer size %d"
+                % (mem_percent, len(self.trainer.episodes)))
+            self.flags.add("memory_over")
+        while len(self.trainer.episodes) > maximum_episodes:
+            self.trainer.episodes.popleft()
+
+    def feed_results(self, results):
+        for result in results:
+            if result is None:
+                continue
+            for p in result["args"]["player"]:
+                model_id = result["args"]["model_id"][p]
+                res = result["result"][p]
+                n, r, r2 = self.results.get(model_id, (0, 0, 0))
+                self.results[model_id] = n + 1, r + res, r2 + res ** 2
+                self.results_per_opponent.setdefault(model_id, {})
+                opponent = result["opponent"]
+                n, r, r2 = self.results_per_opponent[model_id].get(
+                    opponent, (0, 0, 0))
+                self.results_per_opponent[model_id][opponent] = (
+                    n + 1, r + res, r2 + res ** 2)
+
+    # -- epoch boundary ---------------------------------------------
+    def update(self):
+        print()
+        print("epoch %d" % self.model_epoch)
+        epoch_record = {"epoch": self.model_epoch}
+
+        if self.model_epoch not in self.results:
+            print("win rate = Nan (0)")
+        else:
+            def output_wp(name, results):
+                n, r, r2 = results
+                mean = r / (n + 1e-6)
+                name_tag = " (%s)" % name if name != "" else ""
+                print("win rate%s = %.3f (%.1f / %d)"
+                      % (name_tag, (mean + 1) / 2, (r + n) / 2, n))
+                epoch_record["win_rate" + ("_" + name if name else "")] = (
+                    (mean + 1) / 2)
+
+            keys = self.results_per_opponent[self.model_epoch]
+            if len(self.args.get("eval", {}).get("opponent", [])) <= 1 \
+                    and len(keys) <= 1:
+                output_wp("", self.results[self.model_epoch])
+            else:
+                output_wp("total", self.results[self.model_epoch])
+                for key in sorted(keys):
+                    output_wp(key, keys[key])
+
+        if self.model_epoch not in self.generation_results:
+            print("generation stats = Nan (0)")
+        else:
+            n, r, r2 = self.generation_results[self.model_epoch]
+            mean = r / (n + 1e-6)
+            std = (r2 / (n + 1e-6) - mean ** 2) ** 0.5
+            print("generation stats = %.3f +- %.3f" % (mean, std))
+            epoch_record["generation_mean"] = mean
+            epoch_record["generation_std"] = std
+
+        model, steps = self.trainer.update()
+        if model is None:
+            model = self.model
+        self.update_model(model, steps)
+        epoch_record["steps"] = steps
+        epoch_record.update(getattr(self.trainer, "last_metrics", {}))
+        if self.metrics_path:
+            with open(self.metrics_path, "a") as f:
+                f.write(json.dumps(epoch_record) + "\n")
+        self.flags = set()
+
+    # -- server loop -------------------------------------------------
+    def server(self):
+        print("started server")
+        prev_update_episodes = self.args["minimum_episodes"]
+        next_update_episodes = (
+            prev_update_episodes + self.args["update_episodes"])
+
+        while self.worker.connection_count() > 0 or not self.shutdown_flag:
+            try:
+                conn, (req, data) = self.worker.recv(timeout=0.3)
+            except queue.Empty:
+                continue
+
+            multi_req = isinstance(data, list)
+            if not multi_req:
+                data = [data]
+            send_data = []
+
+            if req == "args":
+                if self.shutdown_flag:
+                    send_data = [None] * len(data)
+                else:
+                    for _ in data:
+                        send_data.append(self._assign_job())
+            elif req == "episode":
+                self.feed_episodes(data)
+                send_data = [None] * len(data)
+            elif req == "result":
+                self.feed_results(data)
+                send_data = [None] * len(data)
+            elif req == "model":
+                for model_id in data:
+                    send_data.append(self._serve_model(model_id))
+
+            if not multi_req and len(send_data) == 1:
+                send_data = send_data[0]
+            self.worker.send(conn, send_data)
+
+            if self.num_returned_episodes >= next_update_episodes:
+                prev_update_episodes = next_update_episodes
+                next_update_episodes = (
+                    prev_update_episodes + self.args["update_episodes"])
+                self.update()
+                if 0 <= self.args["epochs"] <= self.model_epoch:
+                    self.shutdown_flag = True
+        print("finished server")
+
+    def _assign_job(self):
+        args = {"model_id": {}}
+        if self.num_results < self.eval_rate * self.num_episodes:
+            args["role"] = "e"
+            args["player"] = [
+                self.env.players()[
+                    self.num_results % len(self.env.players())]]
+            self.num_results += 1
+        else:
+            args["role"] = "g"
+            args["player"] = self.env.players()
+            self.num_episodes += 1
+        for p in self.env.players():
+            args["model_id"][p] = (
+                self.model_epoch if p in args["player"] else -1)
+        return args
+
+    def _serve_model(self, model_id):
+        model = self.model
+        if model_id != self.model_epoch and model_id > 0:
+            try:
+                with open(model_path(model_id), "rb") as f:
+                    state = pickle.load(f)
+                model = TPUModel(self.model.module, state["params"])
+            except OSError:
+                pass  # serve the latest model if the file is missing
+        return pickle.dumps(model)
+
+    def run(self):
+        threading.Thread(target=self.trainer.run, daemon=True).start()
+        self.worker.run()
+        self.server()
+
+
+def train_main(args):
+    prepare_env(args["env_args"])
+    learner = Learner(args=args)
+    learner.run()
+
+
+def train_server_main(args):
+    learner = Learner(args=args, remote=True)
+    learner.run()

@@ -1,0 +1,67 @@
+"""Policy-value-return DRC network for Geister.
+
+Capability parity with the reference ``GeisterNet``
+(/root/reference/handyrl/envs/geister.py:130-166): scalar features
+broadcast onto the board planes, conv stem, 3-layer DRC body repeated
+3x, a move policy head (4 directions x 36 cells), a 70-way piece-layout
+set head driven by the turn-color scalar, a tanh value head and an
+unsquashed return head — here NHWC Flax with GroupNorm.
+"""
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from .blocks import PolicyHead, ValueHead, pick_num_groups
+from .recurrent import DRC
+
+BOARD = (6, 6)
+NUM_MOVE_ACTIONS = 4 * 36
+NUM_SET_ACTIONS = 70
+
+
+class GeisterNet(nn.Module):
+    filters: int = 32
+    drc_layers: int = 3
+    drc_repeats: int = 3
+
+    def init_hidden(self, batch_shape=()):
+        return DRC.initial_state(
+            self.drc_layers, BOARD, self.filters, batch_shape)
+
+    @nn.compact
+    def __call__(self, obs, hidden):
+        board, scalar = obs["board"], obs["scalar"]  # (B,6,6,7), (B,18)
+        if hidden is None:
+            hidden = self.init_hidden((board.shape[0],))
+
+        s_planes = jnp.broadcast_to(
+            scalar[:, None, None, :],
+            (scalar.shape[0],) + BOARD + (scalar.shape[-1],),
+        )
+        h = jnp.concatenate([s_planes, board], axis=-1)
+
+        h = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=False)(h)
+        h = nn.GroupNorm(num_groups=pick_num_groups(self.filters))(h)
+        h = nn.relu(h)
+
+        h, new_hidden = DRC(
+            self.drc_layers, self.filters, num_repeats=self.drc_repeats
+        )(h, hidden)
+
+        # move policy: conv head emitting 4 direction planes -> 144 logits
+        pm = nn.Conv(8, (3, 3), padding="SAME", use_bias=False)(h)
+        pm = nn.GroupNorm(num_groups=pick_num_groups(8))(pm)
+        pm = nn.relu(pm)
+        pm = nn.Conv(4, (1, 1), use_bias=False)(pm)
+        # (B, 6, 6, 4) -> direction-major flat order d*36 + x*6 + y
+        pm = jnp.transpose(pm, (0, 3, 1, 2)).reshape(pm.shape[0], -1)
+
+        # set policy: layout prior from the turn-color scalar alone
+        turn_color = scalar[:, :1]
+        ps = nn.Dense(NUM_SET_ACTIONS)(turn_color)
+
+        policy = jnp.concatenate([pm, ps], axis=-1)
+        value = ValueHead(bottleneck=2)(h)
+        ret = ValueHead(bottleneck=2, squash=False)(h)
+        return {"policy": policy, "value": value, "return": ret,
+                "hidden": new_hidden}

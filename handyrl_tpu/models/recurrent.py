@@ -1,0 +1,76 @@
+"""Recurrent building blocks: ConvLSTM and DRC (Deep Repeated ConvLSTM).
+
+Capability parity with the reference's DRC body
+(/root/reference/handyrl/envs/geister.py:17-97, per arXiv:1901.03559):
+``num_layers`` ConvLSTM cells applied ``num_repeats`` times per step,
+layer i>0 reading layer i-1's fresh hidden state.
+
+TPU-native conventions: NHWC layout (the cell's gate computation is one
+fused conv over [x, h] concatenated on channels — a single MXU-friendly
+contraction per cell call); hidden state is a flat pytree
+``{"h0": ..., "c0": ..., "h1": ...}`` whose every leaf has shape
+``(*batch, H, W, C)`` — batch dims leading, so the framework's
+mask/blend tree algebra (ops/losses.py forward_prediction) applies
+uniformly to every leaf.
+"""
+
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class ConvLSTMCell(nn.Module):
+    """One ConvLSTM cell: gates from a single conv over [x, h]."""
+
+    hidden_dim: int
+    kernel: int = 3
+
+    @nn.compact
+    def __call__(self, x, h, c):
+        combined = jnp.concatenate([x, h], axis=-1)
+        gates = nn.Conv(
+            4 * self.hidden_dim, (self.kernel, self.kernel), padding="SAME"
+        )(combined)
+        i, f, o, g = jnp.split(gates, 4, axis=-1)
+        c_next = nn.sigmoid(f) * c + nn.sigmoid(i) * jnp.tanh(g)
+        h_next = nn.sigmoid(o) * jnp.tanh(c_next)
+        return h_next, c_next
+
+
+class DRC(nn.Module):
+    """Deep Repeated ConvLSTM: L cells repeated R times per step."""
+
+    num_layers: int
+    hidden_dim: int
+    kernel: int = 3
+    num_repeats: int = 3
+
+    @nn.compact
+    def __call__(self, x, hidden: Dict[str, jnp.ndarray]):
+        hs = [hidden[f"h{i}"] for i in range(self.num_layers)]
+        cs = [hidden[f"c{i}"] for i in range(self.num_layers)]
+        cells = [
+            ConvLSTMCell(self.hidden_dim, self.kernel)
+            for _ in range(self.num_layers)
+        ]
+        for _ in range(self.num_repeats):
+            for i, cell in enumerate(cells):
+                inp = hs[i - 1] if i > 0 else x
+                hs[i], cs[i] = cell(inp, hs[i], cs[i])
+        new_hidden = {}
+        for i in range(self.num_layers):
+            new_hidden[f"h{i}"] = hs[i]
+            new_hidden[f"c{i}"] = cs[i]
+        return hs[-1], new_hidden
+
+    @staticmethod
+    def initial_state(num_layers: int, spatial: Tuple[int, int],
+                      hidden_dim: int, batch_shape: Tuple[int, ...] = ()):
+        """Zero hidden state; every leaf is (*batch, H, W, hidden_dim)."""
+        shape = tuple(batch_shape) + tuple(spatial) + (hidden_dim,)
+        state = {}
+        for i in range(num_layers):
+            state[f"h{i}"] = jnp.zeros(shape, jnp.float32)
+            state[f"c{i}"] = jnp.zeros(shape, jnp.float32)
+        return state

@@ -1,0 +1,32 @@
+"""Device-mesh parallelism for the learner.
+
+The reference's only device parallelism is single-process
+``nn.DataParallel`` (/root/reference/handyrl/train.py:340-341).  Here the
+learner scales over a ``jax.sharding.Mesh`` instead: the batch is
+sharded over the ``dp`` axis, parameters are replicated (or sharded over
+``tp``/``fsdp`` by rule), and XLA inserts the gradient all-reduce over
+ICI — no hand-written collectives in the update step.
+
+Axes (any subset may be size 1):
+  dp   — data parallel: batch dim of every batch tensor
+  tp   — tensor parallel: output features of large dense/conv kernels
+  sp   — sequence parallel: the time axis of long-sequence batches
+"""
+
+from .mesh import (
+    MeshSpec,
+    batch_sharding,
+    make_mesh,
+    param_sharding,
+    replicated,
+)
+from .update import make_sharded_update_step
+
+__all__ = [
+    "MeshSpec",
+    "make_mesh",
+    "batch_sharding",
+    "param_sharding",
+    "replicated",
+    "make_sharded_update_step",
+]

@@ -1,0 +1,115 @@
+"""Mesh construction and sharding specs.
+
+One place decides how arrays lay out over devices; everything else just
+asks for a sharding.  Design follows the standard JAX recipe: build a
+``Mesh``, annotate shardings with ``NamedSharding``/``PartitionSpec``,
+and let XLA insert the collectives.
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# canonical axis order: data, sequence(time), tensor(model)
+AXES = ("dp", "sp", "tp")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Logical mesh shape, e.g. ``MeshSpec(dp=4, tp=2)``.
+
+    Axis sizes of 1 are kept in the mesh (so sharding specs never need
+    to special-case a missing axis); total size must divide the device
+    count.
+    """
+
+    dp: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    @classmethod
+    def from_config(cls, mesh_cfg: Optional[Dict[str, int]]) -> "MeshSpec":
+        mesh_cfg = dict(mesh_cfg or {})
+        unknown = set(mesh_cfg) - set(AXES)
+        if unknown:
+            raise ValueError(f"unknown mesh axes: {sorted(unknown)}")
+        return cls(**{a: int(mesh_cfg.get(a, 1)) for a in AXES})
+
+    @property
+    def size(self) -> int:
+        return self.dp * self.sp * self.tp
+
+    def shape(self) -> Tuple[int, ...]:
+        return (self.dp, self.sp, self.tp)
+
+
+def make_mesh(spec: Optional[MeshSpec] = None, devices=None) -> Mesh:
+    """Build a ``Mesh`` over ``devices`` (default: all visible).
+
+    With no spec, every device goes on ``dp`` — pure data parallelism,
+    the reference-parity strategy (DataParallel -> psum-over-ICI).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if spec is None:
+        spec = MeshSpec(dp=len(devices))
+    if spec.size != len(devices):
+        raise ValueError(
+            f"mesh {spec.shape()} needs {spec.size} devices, have {len(devices)}"
+        )
+    dev_array = np.asarray(devices).reshape(spec.shape())
+    return Mesh(dev_array, AXES)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, time_axis: Optional[int] = None) -> NamedSharding:
+    """Batch tensors shard their leading dim over ``dp``; optionally the
+    time axis over ``sp`` (sequence parallelism for long windows)."""
+    if time_axis is None:
+        return NamedSharding(mesh, P("dp"))
+    spec = [None] * (time_axis + 1)
+    spec[0], spec[time_axis] = "dp", "sp"
+    return NamedSharding(mesh, P(*spec))
+
+
+# -- parameter sharding rules -------------------------------------------
+
+def _tp_spec_for(path: Tuple[str, ...], shape: Tuple[int, ...],
+                 tp_size: int, min_tp_dim: int) -> P:
+    """Shard the output-feature (last) dim of large kernels over ``tp``.
+
+    Conv kernels are (kh, kw, cin, cout) and dense kernels (cin, cout)
+    in Flax — the last axis is always output features.  Small tensors
+    (biases, norms, tiny heads) stay replicated: the all-gather cost
+    would exceed the memory saved.
+    """
+    if tp_size <= 1 or not shape:
+        return P()
+    last = shape[-1]
+    if last % tp_size != 0 or last < min_tp_dim:
+        return P()
+    if len(shape) < 2:
+        return P()
+    return P(*([None] * (len(shape) - 1) + ["tp"]))
+
+
+def param_sharding(mesh: Mesh, params, min_tp_dim: int = 128):
+    """NamedShardings for a params pytree.
+
+    Default policy: replicate everything unless the mesh has a real
+    ``tp`` axis, in which case wide kernels shard their output features.
+    """
+    tp_size = mesh.shape["tp"]
+
+    def spec(path, leaf):
+        names = tuple(getattr(p, "key", str(p)) for p in path)
+        return NamedSharding(
+            mesh, _tp_spec_for(names, np.shape(leaf), tp_size, min_tp_dim)
+        )
+
+    return jax.tree_util.tree_map_with_path(spec, params)

@@ -1,0 +1,320 @@
+"""Actor-side runtime: workers, gather fan-in, local & remote clusters.
+
+Role parity with /root/reference/handyrl/worker.py:26-271.  Workers are
+CPU processes running self-play (generation) or evaluation matches; a
+tree of Gather processes batches their requests so the learner serves
+O(num_gathers) connections instead of O(num_workers).  Remote machines
+join elastically through an entry handshake.
+
+TPU-native specifics: every child process pins its JAX to the CPU
+backend (``force_cpu_jax``) — actor inference is a CPU-jitted forward,
+the TPU belongs to the learner's update step alone.  Processes are
+spawned, not forked, because PJRT clients do not survive fork.
+
+Ports (same as the reference so operational docs carry over):
+  9999 — entry server: one-shot handshake assigning worker-id blocks
+  9998 — worker server: persistent gather connections
+"""
+
+import copy
+import functools
+import pickle
+import queue
+import random
+import threading
+import time
+from collections import deque
+from socket import gethostname
+
+from .connection import (
+    QueueCommunicator,
+    _mp,
+    accept_socket_connections,
+    force_cpu_jax,
+    open_multiprocessing_connections,
+    open_socket_connection,
+    send_recv,
+)
+
+ENTRY_PORT = 9999
+WORKER_PORT = 9998
+
+
+class Worker:
+    """One actor process: request a job, fetch models, roll out, reply."""
+
+    def __init__(self, args, conn, wid):
+        print(f"opened worker {wid}")
+        self.worker_id = wid
+        self.args = args
+        self.conn = conn
+        self.latest_model = (-1, None)
+
+        from .environment import make_env
+        from .evaluation import Evaluator
+        from .generation import Generator
+
+        self.env = make_env({**args["env"], "id": wid})
+        self.generator = Generator(self.env, self.args)
+        self.evaluator = Evaluator(self.env, self.args)
+        random.seed(args["seed"] + wid)
+
+    def __del__(self):
+        print(f"closed worker {self.worker_id}")
+
+    def _gather_models(self, model_ids):
+        from .models import RandomModel
+
+        model_pool = {}
+        for model_id in model_ids:
+            if model_id not in model_pool:
+                if model_id < 0:
+                    model_pool[model_id] = None
+                elif model_id == self.latest_model[0]:
+                    # the latest model is cached locally
+                    model_pool[model_id] = self.latest_model[1]
+                else:
+                    # request a snapshot from the learner
+                    model = pickle.loads(
+                        send_recv(self.conn, ("model", model_id)))
+                    if model_id == 0:
+                        # id 0 = uniform-random stand-in
+                        self.env.reset()
+                        obs = self.env.observation(self.env.players()[0])
+                        model = RandomModel(model, obs)
+                    model_pool[model_id] = model
+                    if model_id > self.latest_model[0]:
+                        self.latest_model = (model_id, model)
+        return model_pool
+
+    def run(self):
+        while True:
+            try:
+                args = send_recv(self.conn, ("args", None))
+            except (ConnectionResetError, BrokenPipeError, EOFError, OSError):
+                break  # learner/gather is gone: exit quietly
+            if args is None:
+                break
+            role = args["role"]
+
+            models = {}
+            if "model_id" in args:
+                model_ids = list(args["model_id"].values())
+                try:
+                    model_pool = self._gather_models(model_ids)
+                except (ConnectionResetError, BrokenPipeError, EOFError,
+                        OSError):
+                    break  # learner/gather is gone: exit quietly
+                for p, model_id in args["model_id"].items():
+                    models[p] = model_pool[model_id]
+
+            if role == "g":
+                episode = self.generator.execute(models, args)
+                send_recv(self.conn, ("episode", episode))
+            elif role == "e":
+                result = self.evaluator.execute(models, args)
+                send_recv(self.conn, ("result", result))
+
+
+def make_worker_args(args, n_ga, gaid, base_wid, wid):
+    # interleaved worker ids across gathers (reference worker.py:90-91)
+    return args, base_wid + wid * n_ga + gaid
+
+
+def open_worker(conn, args, wid):
+    force_cpu_jax()
+    worker = Worker(args, conn, wid)
+    worker.run()
+
+
+class Gather(QueueCommunicator):
+    """Fan-in proxy: one process per ~16 workers.
+
+    Prefetches job-arg blocks, caches model replies by id, and batches
+    episode/result uploads so learner round trips scale with gathers,
+    not workers (parity with /root/reference/handyrl/worker.py:99-173).
+    """
+
+    def __init__(self, args, conn, gather_id):
+        print(f"started gather {gather_id}")
+        self.gather_id = gather_id
+        self.server_conn = conn
+        self.args_queue = deque()
+        self.data_map = {"model": {}}
+        self.result_send_map = {}
+        self.result_send_cnt = 0
+
+        n_pro = args["worker"]["num_parallel"]
+        n_ga = args["worker"]["num_gathers"]
+        num_workers = n_pro // n_ga + int(gather_id < n_pro % n_ga)
+        base_wid = args["worker"].get("base_worker_id", 0)
+
+        worker_conns = open_multiprocessing_connections(
+            num_workers,
+            open_worker,
+            functools.partial(make_worker_args, args, n_ga, gather_id,
+                              base_wid),
+        )
+        super().__init__(worker_conns)
+        self.buffer_length = 1 + len(worker_conns) // 4
+
+    def run(self):
+        while self.connection_count() > 0:
+            try:
+                conn, (command, args) = self.recv(timeout=0.3)
+            except queue.Empty:
+                continue
+
+            if command == "args":
+                if not self.args_queue:
+                    # prefetch a block of job assignments
+                    self.server_conn.send(
+                        (command, [None] * self.buffer_length))
+                    self.args_queue.extend(self.server_conn.recv())
+                self.send(conn, self.args_queue.popleft())
+
+            elif command in self.data_map:
+                # cacheable request (model snapshots keyed by id)
+                if args not in self.data_map[command]:
+                    self.server_conn.send((command, args))
+                    self.data_map[command][args] = self.server_conn.recv()
+                self.send(conn, self.data_map[command][args])
+
+            else:
+                # ack first, batch the upload
+                self.send(conn, None)
+                self.result_send_map.setdefault(command, []).append(args)
+                self.result_send_cnt += 1
+                if self.result_send_cnt >= self.buffer_length:
+                    self._flush_results()
+
+    def _flush_results(self):
+        for command, args_list in self.result_send_map.items():
+            self.server_conn.send((command, args_list))
+            self.server_conn.recv()
+        self.result_send_map = {}
+        self.result_send_cnt = 0
+
+
+def gather_loop(args, conn, gather_id):
+    force_cpu_jax()
+    gather = Gather(args, conn, gather_id)
+    try:
+        gather.run()
+    except (ConnectionResetError, BrokenPipeError, EOFError, OSError):
+        pass  # learner is gone: exit quietly
+
+
+class WorkerCluster(QueueCommunicator):
+    """Local actor pool: gather processes over pipes."""
+
+    def __init__(self, args):
+        super().__init__()
+        self.args = args
+
+    def run(self):
+        if "num_gathers" not in self.args["worker"]:
+            self.args["worker"]["num_gathers"] = (
+                1 + max(0, self.args["worker"]["num_parallel"] - 1) // 16)
+        for i in range(self.args["worker"]["num_gathers"]):
+            conn0, conn1 = _mp.Pipe(duplex=True)
+            # gathers spawn worker children, so they cannot be daemonic;
+            # they exit on their own once every worker disconnects
+            _mp.Process(
+                target=gather_loop, args=(self.args, conn1, i)
+            ).start()
+            conn1.close()
+            self.add_connection(conn0)
+
+
+class WorkerServer(QueueCommunicator):
+    """Learner-side acceptor for remote worker machines.
+
+    Two listener threads: the entry port hands out worker-id blocks and
+    the merged config; the worker port accepts persistent gather
+    connections into the communicator (elastic joins, parity with
+    /root/reference/handyrl/worker.py:192-224).
+    """
+
+    def __init__(self, args):
+        super().__init__()
+        self.args = args
+        self.total_worker_count = 0
+
+    def run(self):
+        threading.Thread(target=self._entry_server, daemon=True).start()
+        threading.Thread(target=self._worker_server, daemon=True).start()
+
+    def _entry_server(self):
+        print(f"started entry server {ENTRY_PORT}")
+        for conn in accept_socket_connections(port=ENTRY_PORT):
+            if conn is None:
+                continue
+            worker_args = conn.recv()
+            print(f"accepted connection from {worker_args['address']}")
+            worker_args["base_worker_id"] = self.total_worker_count
+            self.total_worker_count += worker_args["num_parallel"]
+            args = copy.deepcopy(self.args)
+            args["worker"] = worker_args
+            conn.send(args)
+            conn.close()
+
+    def _worker_server(self):
+        print(f"started worker server {WORKER_PORT}")
+        for conn in accept_socket_connections(port=WORKER_PORT):
+            if conn is None:
+                continue
+            self.add_connection(conn)
+
+
+def entry(worker_args):
+    """Remote machine -> learner handshake; returns the merged config."""
+    conn = open_socket_connection(worker_args["server_address"], ENTRY_PORT)
+    conn.send(worker_args)
+    args = conn.recv()
+    conn.close()
+    return args
+
+
+class RemoteWorkerCluster:
+    """Worker-machine runtime: handshake, then gathers dialing the
+    learner's worker port."""
+
+    def __init__(self, args):
+        args["address"] = gethostname()
+        if "num_gathers" not in args:
+            args["num_gathers"] = 1 + max(0, args["num_parallel"] - 1) // 16
+        self.args = args
+
+    def run(self):
+        args = entry(self.args)
+        print(args)
+        from .environment import prepare_env
+
+        prepare_env(args["env"])
+
+        process = []
+        try:
+            for i in range(self.args["num_gathers"]):
+                conn = open_socket_connection(
+                    self.args["server_address"], WORKER_PORT)
+                p = _mp.Process(
+                    target=gather_loop, args=(args, conn, i))
+                p.start()
+                conn.close()
+                process.append(p)
+            while True:
+                time.sleep(100)
+        finally:
+            for p in process:
+                p.terminate()
+
+
+def worker_main(args, argv):
+    worker_args = args["worker_args"]
+    if len(argv) >= 1:
+        worker_args["num_parallel"] = int(argv[0])
+        worker_args.pop("num_gathers", None)
+
+    worker = RemoteWorkerCluster(args=worker_args)
+    worker.run()

@@ -3,6 +3,10 @@
 This is the TPU-native analog of "multi-node without a cluster": every
 sharding/collective test runs on a virtual 8-device mesh so the full
 multi-chip path compiles and executes in CI with no TPU attached.
+
+The env var alone is not enough here: the host's sitecustomize may
+pre-register an accelerator plugin and pin ``jax.config.jax_platforms``,
+which outranks ``JAX_PLATFORMS`` — so we also set the config directly.
 """
 
 import os
@@ -13,3 +17,7 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402  (import after env setup on purpose)
+
+jax.config.update("jax_platforms", "cpu")

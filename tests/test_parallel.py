@@ -1,0 +1,82 @@
+"""Multi-device sharding tests on the virtual 8-device CPU mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from handyrl_tpu.parallel import MeshSpec, make_mesh, make_sharded_update_step
+from handyrl_tpu.parallel.mesh import batch_sharding, param_sharding
+
+
+def _need_devices(n):
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} virtual devices")
+
+
+def test_mesh_spec_from_config():
+    spec = MeshSpec.from_config({"dp": 4, "tp": 2})
+    assert spec.size == 8 and spec.shape() == (4, 1, 2)
+    with pytest.raises(ValueError):
+        MeshSpec.from_config({"bogus": 2})
+
+
+def test_make_mesh_default_all_dp():
+    _need_devices(8)
+    mesh = make_mesh()
+    assert mesh.shape["dp"] == len(jax.devices())
+    assert mesh.shape["tp"] == 1
+
+
+def test_param_sharding_tp_rule():
+    _need_devices(8)
+    mesh = make_mesh(MeshSpec(dp=4, tp=2), devices=jax.devices()[:8])
+    params = {
+        "dense": {"kernel": np.zeros((64, 256)), "bias": np.zeros((256,))},
+        "conv": {"kernel": np.zeros((3, 3, 32, 128))},
+        "head": {"kernel": np.zeros((32, 9))},
+    }
+    shardings = param_sharding(mesh, params)
+    # wide kernels shard output features over tp
+    assert shardings["dense"]["kernel"].spec == jax.sharding.PartitionSpec(None, "tp")
+    assert shardings["conv"]["kernel"].spec == jax.sharding.PartitionSpec(
+        None, None, None, "tp")
+    # biases and narrow heads replicate
+    assert shardings["dense"]["bias"].spec == jax.sharding.PartitionSpec()
+    assert shardings["head"]["kernel"].spec == jax.sharding.PartitionSpec()
+
+
+@pytest.mark.slow
+def test_sharded_update_step_dp():
+    """Full training step, batch sharded dp=4: compiles, runs, finite."""
+    _need_devices(4)
+    import sys, pathlib
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+    from __graft_entry__ import _build_model_and_batch
+
+    from handyrl_tpu.ops.losses import LossConfig
+    from handyrl_tpu.ops.update import make_optimizer
+
+    mesh = make_mesh(MeshSpec(dp=4), devices=jax.devices()[:4])
+    model, batch, cfg = _build_model_and_batch(batch_size=4)
+    loss_cfg = LossConfig.from_config(cfg)
+    optimizer = make_optimizer(1e-3)
+    params, opt_state = model.params, None
+    opt_state = optimizer.init(params)
+
+    update = make_sharded_update_step(model, loss_cfg, optimizer, mesh, params)
+    params2, opt_state, metrics = update(params, opt_state, batch)
+    assert np.isfinite(float(metrics["total"]))
+    # params changed and stayed replicated
+    leaf = jax.tree.leaves(params2)[0]
+    assert leaf.sharding.is_fully_replicated
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_8():
+    _need_devices(8)
+    import sys, pathlib
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+    from __graft_entry__ import dryrun_multichip
+
+    dryrun_multichip(8)
