@@ -1,0 +1,113 @@
+"""Geister: DRC net forward, RNN batch path, burn-in update step."""
+
+import random
+
+import numpy as np
+import pytest
+
+from handyrl_tpu.batch import make_batch
+from handyrl_tpu.envs.geister import Environment as Geister
+from handyrl_tpu.generation import Generator
+from handyrl_tpu.models import TPUModel
+from handyrl_tpu.ops.losses import LossConfig
+from handyrl_tpu.ops.update import make_optimizer, make_update_step
+
+CFG = {
+    "turn_based_training": True,
+    "observation": False,
+    "gamma": 0.97,
+    "forward_steps": 8,
+    "burn_in_steps": 4,
+    "compress_steps": 4,
+    "entropy_regularization": 0.1,
+    "entropy_regularization_decay": 0.1,
+    "lambda": 0.7,
+    "policy_target": "TD",
+    "value_target": "TD",
+}
+
+
+def _model_and_episodes(n, seed=0):
+    random.seed(seed)
+    env = Geister()
+    env.reset()
+    model = TPUModel(env.net())
+    model.init_params(env.observation(env.turn()), seed=seed)
+    gen = Generator(env, CFG)
+    args = {"player": [0, 1], "model_id": {0: 1, 1: 1}}
+    episodes = []
+    while len(episodes) < n:
+        ep = gen.generate({0: model, 1: model}, args)
+        if ep is not None:
+            episodes.append(ep)
+    return model, episodes
+
+
+def test_net_inference_shapes():
+    env = Geister()
+    env.reset()
+    model = TPUModel(env.net())
+    model.init_params(env.observation(env.turn()))
+    out = model.inference(env.observation(env.turn()), model.init_hidden())
+    assert out["policy"].shape == (214,)
+    assert out["value"].shape == (1,)
+    assert out["return"].shape == (1,)
+    assert out["hidden"]["h0"].shape == (6, 6, 32)
+    assert -1.0 <= float(out["value"][0]) <= 1.0
+
+
+@pytest.mark.slow
+def test_generation_and_batch_with_burn_in():
+    model, episodes = _model_and_episodes(2)
+    assert all(ep["steps"] >= 3 for ep in episodes)
+
+    def select(ep):
+        train_st = min(4, ep["steps"] - 1)
+        st = max(0, train_st - CFG["burn_in_steps"])
+        ed = min(train_st + CFG["forward_steps"], ep["steps"])
+        cmp = CFG["compress_steps"]
+        st_block, ed_block = st // cmp, (ed - 1) // cmp + 1
+        return {
+            "args": ep["args"], "outcome": ep["outcome"],
+            "moment": ep["moment"][st_block:ed_block],
+            "base": st_block * cmp,
+            "start": st, "end": ed, "train_start": train_st,
+            "total": ep["steps"],
+        }
+
+    batch = make_batch([select(ep) for ep in episodes], CFG)
+    T = CFG["burn_in_steps"] + CFG["forward_steps"]
+    assert batch["observation"]["board"].shape == (2, T, 1, 6, 6, 7)
+    assert batch["observation"]["scalar"].shape == (2, T, 1, 18)
+    assert batch["action_mask"].shape == (2, T, 1, 214)
+    assert batch["value"].shape[1] == T
+
+
+@pytest.mark.slow
+def test_update_step_rnn_burn_in_finite():
+    model, episodes = _model_and_episodes(2)
+
+    def select(ep):
+        train_st = min(CFG["burn_in_steps"], ep["steps"] - 1)
+        st = max(0, train_st - CFG["burn_in_steps"])
+        ed = min(train_st + CFG["forward_steps"], ep["steps"])
+        cmp = CFG["compress_steps"]
+        return {
+            "args": ep["args"], "outcome": ep["outcome"],
+            "moment": ep["moment"][st // cmp:(ed - 1) // cmp + 1],
+            "base": (st // cmp) * cmp,
+            "start": st, "end": ed, "train_start": train_st,
+            "total": ep["steps"],
+        }
+
+    batch = make_batch([select(ep) for ep in episodes], CFG)
+    loss_cfg = LossConfig.from_config(CFG)
+    optimizer = make_optimizer(1e-3)
+    params = model.params
+    opt_state = optimizer.init(params)
+    update = make_update_step(model, loss_cfg, optimizer)
+
+    params, opt_state, metrics = update(params, opt_state, batch)
+    for k in ("p", "v", "r", "ent", "total", "grad_norm"):
+        assert np.isfinite(float(metrics[k])), (k, float(metrics[k]))
+    assert float(metrics["grad_norm"]) > 0
