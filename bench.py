@@ -17,8 +17,20 @@ def main():
     from handyrl_tpu.ops.losses import LossConfig
     from handyrl_tpu.ops.update import make_optimizer, make_update_step
 
+    import numpy as np
+
+    # generate a few real episodes, then tile to the benchmark batch
+    # size — rollout inference through the device tunnel is slow and is
+    # not what this benchmark measures (actors run on CPU in production)
     batch_size = 64
-    model, batch, cfg = _build_model_and_batch(batch_size=batch_size)
+    seed_eps = 4
+    model, batch, cfg = _build_model_and_batch(
+        batch_size=seed_eps, env_name="HungryGeese")
+    import jax
+
+    reps = batch_size // seed_eps
+    batch = jax.tree.map(
+        lambda v: np.tile(v, (reps,) + (1,) * (v.ndim - 1)), batch)
     loss_cfg = LossConfig.from_config(cfg)
     optimizer = make_optimizer(1e-3)
     params = model.params
@@ -48,7 +60,8 @@ def main():
     print(json.dumps({
         "metric": "learner_update_steps_per_sec",
         "value": round(steps_per_sec, 2),
-        "unit": f"steps/sec (batch={batch_size}x{cfg['forward_steps']})",
+        "unit": (f"steps/sec (GeeseNet, "
+                 f"batch={batch_size}x{cfg['forward_steps']})"),
         "vs_baseline": round(vs, 3),
     }))
 

@@ -312,13 +312,24 @@ def client_mp_child(env_args, model_path, conn):
 
 
 def load_model(model_path, env):
-    """Load a saved checkpoint into a TPUModel for evaluation."""
+    """Load a saved checkpoint (.ckpt pickle or exported .npz) into a
+    TPUModel for evaluation."""
     import pickle
 
     model = TPUModel(env.net())
+    if model_path.endswith(".npz"):
+        import numpy as np
+
+        from .utils.tree import unflatten_params
+
+        archive = np.load(model_path)
+        model.params = unflatten_params({
+            key: archive[key] for key in archive.files
+            if key != "__header__"
+        })
+        return model
     with open(model_path, "rb") as f:
-        blob = f.read()
-    state = pickle.loads(blob)
+        state = pickle.load(f)
     params = state["params"] if isinstance(state, dict) and "params" in state \
         else state
     model.params = params

@@ -67,6 +67,10 @@ def latest_model_path():
     return os.path.join(_models_dir(), "latest.ckpt")
 
 
+def train_state_path():
+    return os.path.join(_models_dir(), "train_state.ckpt")
+
+
 def _batch_worker(conn, bid, cfg):
     """Batcher child process: decompress + assemble numpy batches."""
     from .connection import force_cpu_jax
@@ -158,6 +162,7 @@ class Trainer:
         self.default_lr = DEFAULT_LR
         self.data_cnt_ema = args["batch_size"] * args["forward_steps"]
         self.num_params = len(jax.tree.leaves(model.params or {}))
+        self.epoch = args.get("restart_epoch", 0)
         self.steps = 0
         self.update_flag = False
         self.update_queue = queue.Queue(maxsize=1)
@@ -169,8 +174,45 @@ class Trainer:
             self.params = model.params
             self.opt_state = self.optimizer.init(self.params)
             self.update_step = self._build_update_step()
+            self._maybe_restore_train_state()
         else:
             self.optimizer = None
+
+    def _maybe_restore_train_state(self):
+        """Resume optimizer state on restart (the reference checkpoints
+        the model only — restoring Adam moments + the lr EMA makes
+        restarts seamless instead of re-warming the optimizer)."""
+        restart_epoch = self.args.get("restart_epoch", 0)
+        if restart_epoch <= 0:
+            return
+        try:
+            with open(train_state_path(), "rb") as f:
+                state = pickle.load(f)
+        except (OSError, pickle.UnpicklingError, EOFError):
+            return  # missing or truncated: cold-start the optimizer
+        if state.get("epoch") != restart_epoch:
+            # optimizer state belongs to a different epoch's params
+            print("train state is for epoch %s, not %d: cold-starting"
+                  % (state.get("epoch"), restart_epoch))
+            return
+        self.opt_state = jax.tree.map(
+            lambda like, saved: jax.numpy.asarray(saved),
+            self.opt_state, state["opt_state"])
+        self.steps = state["steps"]
+        self.data_cnt_ema = state["data_cnt_ema"]
+        print(f"restored optimizer state at step {self.steps}")
+
+    def save_train_state(self, epoch):
+        state = {
+            "opt_state": jax.tree.map(np.asarray, self.opt_state),
+            "steps": self.steps,
+            "data_cnt_ema": self.data_cnt_ema,
+            "epoch": epoch,
+        }
+        tmp = train_state_path() + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(state, f)
+        os.replace(tmp, train_state_path())
 
     def _build_update_step(self):
         mesh_cfg = self.args.get("mesh") or {}
@@ -221,10 +263,17 @@ class Trainer:
         lr = self.default_lr * self.data_cnt_ema / (1 + self.steps * 1e-5)
         self.opt_state = set_learning_rate(self.opt_state, lr)
 
-        # snapshot: device -> host once per epoch
+        # snapshot: device -> host once per epoch (trainer thread owns
+        # the device buffers, so saving here cannot race a donation)
         snapshot = TPUModel(self.model.module)
         snapshot.params = jax.tree.map(np.asarray, self.params)
         self.last_metrics = {k: l / data_cnt for k, l in loss_sum.items()}
+        self.epoch += 1
+        try:
+            os.makedirs(_models_dir(), exist_ok=True)
+            self.save_train_state(self.epoch)
+        except OSError:
+            pass
         return snapshot
 
     def run(self):

@@ -1,0 +1,58 @@
+"""Torus-convolution residual policy-value net for Hungry Geese.
+
+Capability parity with the reference ``GeeseNet``/``TorusConv2d``
+(/root/reference/handyrl/envs/kaggle/hungry_geese.py:23-59): wrap-around
+padding so convs see the board's toroidal topology, a 32-filter stem +
+12 residual blocks, a policy head read from the goose's head cell and a
+value head from [head features, board-average features] — NHWC Flax
+with GroupNorm.
+
+The whole body is a single fused conv stack: 7x11x32 activations are
+tiny, so the batch dimension carries the MXU load — exactly the shape
+of the learner's (B*T) flattened forward.
+"""
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from .blocks import pick_num_groups
+
+
+class TorusConv(nn.Module):
+    """Conv with wrap-around (toroidal) padding."""
+
+    filters: int
+    kernel: int = 3
+    use_norm: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        e = self.kernel // 2
+        h = jnp.pad(x, ((0, 0), (e, e), (e, e), (0, 0)), mode="wrap")
+        h = nn.Conv(self.filters, (self.kernel, self.kernel),
+                    padding="VALID", use_bias=not self.use_norm)(h)
+        if self.use_norm:
+            h = nn.GroupNorm(num_groups=pick_num_groups(self.filters))(h)
+        return h
+
+
+class GeeseNet(nn.Module):
+    filters: int = 32
+    blocks: int = 12
+
+    @nn.compact
+    def __call__(self, obs, hidden=None):
+        # obs: (B, 7, 11, 17); plane 0 marks the observer's head cell
+        h = nn.relu(TorusConv(self.filters)(obs))
+        for _ in range(self.blocks):
+            h = nn.relu(h + TorusConv(self.filters)(h))
+
+        head_mask = obs[..., :1]                      # (B, 7, 11, 1)
+        h_head = (h * head_mask).sum(axis=(1, 2))     # (B, C)
+        h_avg = h.mean(axis=(1, 2))                   # (B, C)
+
+        policy = nn.Dense(4, use_bias=False)(h_head)
+        value = jnp.tanh(
+            nn.Dense(1, use_bias=False)(
+                jnp.concatenate([h_head, h_avg], axis=-1)))
+        return {"policy": policy, "value": value}

@@ -52,6 +52,31 @@ def stack_time_player(moment_rows, template):
     )
 
 
+def flatten_params(params, prefix=""):
+    """Nested param dict -> flat ``{"a/b/kernel": array}`` mapping
+    (the on-disk .npz export convention)."""
+    flat = {}
+    for k, v in params.items():
+        key = f"{prefix}/{k}" if prefix else k
+        if isinstance(v, dict):
+            flat.update(flatten_params(v, key))
+        else:
+            flat[key] = np.asarray(v)
+    return flat
+
+
+def unflatten_params(flat):
+    """Inverse of :func:`flatten_params`."""
+    params = {}
+    for key, v in flat.items():
+        parts = key.split("/")
+        node = params
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    return params
+
+
 def softmax_np(x, axis=-1):
     """Numerically-stable softmax on numpy arrays (actor-side sampling)."""
     x = np.asarray(x, dtype=np.float32)

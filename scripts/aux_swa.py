@@ -1,0 +1,63 @@
+"""Stochastic weight averaging over saved epoch checkpoints.
+
+Role parity with /root/reference/scripts/aux_swa.py: running equal-
+weight average of model parameters across an epoch range, written to
+``models/swa.ckpt`` in the same checkpoint format the evaluator loads.
+
+Usage: python scripts/aux_swa.py <first_epoch> <last_epoch> [stride]
+"""
+
+import os
+import pickle
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import numpy as np
+
+
+def average_checkpoints(paths):
+    avg, n = None, 0
+    for path in paths:
+        with open(path, "rb") as f:
+            params = pickle.load(f)["params"]
+        n += 1
+        if avg is None:
+            avg = jax.tree.map(
+                lambda a: np.asarray(a, np.float64), params)
+        else:
+            # running equal-weight mean
+            avg = jax.tree.map(
+                lambda m, a: m + (np.asarray(a, np.float64) - m) / n,
+                avg, params)
+    return jax.tree.map(lambda a: np.asarray(a, np.float32), avg)
+
+
+def main():
+    if len(sys.argv) < 3:
+        print(__doc__)
+        sys.exit(1)
+    first, last = int(sys.argv[1]), int(sys.argv[2])
+    stride = int(sys.argv[3]) if len(sys.argv) > 3 else 1
+
+    paths = []
+    for epoch in range(first, last + 1, stride):
+        path = os.path.join("models", f"{epoch}.ckpt")
+        if os.path.exists(path):
+            paths.append(path)
+    if not paths:
+        print("no checkpoints found in range")
+        sys.exit(1)
+
+    print(f"averaging {len(paths)} checkpoints "
+          f"({paths[0]} .. {paths[-1]})")
+    params = average_checkpoints(paths)
+    out = os.path.join("models", "swa.ckpt")
+    with open(out, "wb") as f:
+        pickle.dump({"params": params, "epoch": last, "swa": True}, f)
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

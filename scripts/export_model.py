@@ -1,0 +1,48 @@
+"""Export a checkpoint for framework-free deployment.
+
+Role parity with /root/reference/scripts/make_onnx_model.py (which
+exports ``.pth`` -> ``.onnx`` for Kaggle kernels).  The TPU-native
+equivalent writes a ``.npz`` archive of flat-named numpy parameters plus
+a JSON header (env name, module class, flat key order) — loadable with
+nothing but numpy, and round-trippable into a ``TPUModel`` via
+``handyrl_tpu.evaluation.load_model``.
+
+Usage: python scripts/export_model.py [model.ckpt] [out.npz]
+"""
+
+import json
+import os
+import pickle
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import yaml
+
+from handyrl_tpu.utils.tree import flatten_params
+
+
+def main():
+    ckpt = sys.argv[1] if len(sys.argv) > 1 else "models/latest.ckpt"
+    out = sys.argv[2] if len(sys.argv) > 2 else (
+        os.path.splitext(ckpt)[0] + ".npz")
+
+    with open("config.yaml") as f:
+        env_name = yaml.safe_load(f)["env_args"]["env"]
+
+    with open(ckpt, "rb") as f:
+        state = pickle.load(f)
+    flat = flatten_params(state["params"])
+    header = json.dumps({
+        "env": env_name,
+        "epoch": state.get("epoch", -1),
+        "keys": list(flat),
+    })
+    np.savez(out, __header__=np.frombuffer(
+        header.encode(), dtype=np.uint8), **flat)
+    print(f"wrote {out} ({len(flat)} tensors)")
+
+
+if __name__ == "__main__":
+    main()

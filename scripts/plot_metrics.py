@@ -1,0 +1,187 @@
+"""Plot training curves from a learner stdout log (or metrics jsonl).
+
+Role parity with /root/reference/scripts/win_rate_plot.py,
+loss_plot.py and stats_plot.py, merged into one tool: the learner's
+stdout format (``updated model(N)``, ``win rate ... = W (w / n)``,
+``loss = k:v ...``, ``generation stats = m +- s``, ``epoch N``) is the
+same public API the reference plot scripts parse, and the structured
+``metrics_path`` jsonl is the TPU-native alternative.
+
+Usage:
+  python scripts/plot_metrics.py train.log [out_prefix]
+  python scripts/plot_metrics.py metrics.jsonl [out_prefix]
+"""
+
+import json
+import os
+import sys
+
+
+def parse_stdout_log(path):
+    """Parse learner stdout into a list of per-epoch records."""
+    epochs = []
+    current = None
+    with open(path) as f:
+        for line in f:
+            line = line.rstrip("\n")
+            if line.startswith("epoch "):
+                try:
+                    current = {"epoch": int(line.split()[1])}
+                except (IndexError, ValueError):
+                    current = {"epoch": len(epochs)}
+                epochs.append(current)
+            elif current is None:
+                continue
+            elif line.startswith("win rate"):
+                parts = line.split()
+                name = "win_rate"
+                if parts[2] != "=":
+                    name += "_" + parts[2].strip("()")
+                try:
+                    games = int(parts[-1].strip("()"))
+                    wp = float(parts[-4]) if games > 0 else 0.0
+                    current[name] = wp
+                    current[name + "_games"] = games
+                except (IndexError, ValueError):
+                    pass
+            elif line.startswith("loss = "):
+                for item in line[len("loss = "):].split():
+                    k, _, v = item.partition(":")
+                    try:
+                        current["loss_" + k] = float(v)
+                    except ValueError:
+                        pass
+            elif line.startswith("generation stats"):
+                parts = line.split()
+                try:
+                    current["generation_mean"] = float(parts[3])
+                    current["generation_std"] = float(parts[5])
+                except (IndexError, ValueError):
+                    pass
+            elif line.startswith("updated"):
+                try:
+                    current["steps"] = int(
+                        line.split("(")[1].rstrip().rstrip(")"))
+                except (IndexError, ValueError):
+                    pass
+    return epochs
+
+
+RAW_LOSS_KEYS = ("p", "v", "r", "ent", "total")
+
+
+def parse_jsonl(path):
+    """Load metrics jsonl, normalizing the learner's raw per-epoch loss
+    keys (p/v/r/ent/total) to the loss_ prefix the plots expect."""
+    epochs = []
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            rec = json.loads(line)
+            for k in RAW_LOSS_KEYS:
+                if k in rec:
+                    rec["loss_" + k] = rec.pop(k)
+            epochs.append(rec)
+    return epochs
+
+
+def moving_average(xs, n):
+    if n <= 1 or len(xs) < n:
+        return xs
+    out = []
+    for i in range(len(xs)):
+        lo, hi = max(0, i - n // 2), min(len(xs), i + n // 2 + 1)
+        out.append(sum(xs[lo:hi]) / (hi - lo))
+    return out
+
+
+def plot(epochs, out_prefix):
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    xs = [e.get("epoch", i) for i, e in enumerate(epochs)]
+
+    # win rates (every win_rate* series)
+    wr_keys = sorted({
+        k for e in epochs for k in e
+        if k.startswith("win_rate") and not k.endswith("_games")})
+    if wr_keys:
+        fig, ax = plt.subplots(figsize=(8, 5))
+        for k in wr_keys:
+            ys = [e.get(k) for e in epochs]
+            pts = [(x, y) for x, y in zip(xs, ys) if y is not None]
+            if pts:
+                ax.plot(*zip(*pts), label=k, alpha=0.35)
+                ax.plot(
+                    [p[0] for p in pts],
+                    moving_average([p[1] for p in pts], 9),
+                    label=k + " (avg)")
+        ax.set_xlabel("epoch")
+        ax.set_ylabel("win rate")
+        ax.set_ylim(0, 1)
+        ax.legend()
+        ax.grid(alpha=0.3)
+        fig.savefig(out_prefix + "_win_rate.png", dpi=120,
+                    bbox_inches="tight")
+        print(f"wrote {out_prefix}_win_rate.png")
+
+    # loss components
+    loss_keys = sorted({
+        k for e in epochs for k in e if k.startswith("loss_")})
+    if loss_keys:
+        fig, ax = plt.subplots(figsize=(8, 5))
+        for k in loss_keys:
+            pts = [(x, e[k]) for x, e in zip(xs, epochs) if k in e]
+            if pts:
+                ax.plot(*zip(*pts), label=k)
+        ax.set_xlabel("epoch")
+        ax.set_ylabel("loss / data count")
+        ax.legend()
+        ax.grid(alpha=0.3)
+        fig.savefig(out_prefix + "_loss.png", dpi=120, bbox_inches="tight")
+        print(f"wrote {out_prefix}_loss.png")
+
+    # generation stats (mean +- std band)
+    pts = [(x, e["generation_mean"], e.get("generation_std", 0.0))
+           for x, e in zip(xs, epochs) if "generation_mean" in e]
+    if pts:
+        fig, ax = plt.subplots(figsize=(8, 5))
+        gx, gm, gs = zip(*pts)
+        ax.plot(gx, gm, label="generation outcome mean")
+        ax.fill_between(
+            gx,
+            [m - s for m, s in zip(gm, gs)],
+            [m + s for m, s in zip(gm, gs)],
+            alpha=0.2)
+        ax.set_xlabel("epoch")
+        ax.set_ylabel("self-play outcome")
+        ax.legend()
+        ax.grid(alpha=0.3)
+        fig.savefig(out_prefix + "_stats.png", dpi=120, bbox_inches="tight")
+        print(f"wrote {out_prefix}_stats.png")
+
+
+def main():
+    if len(sys.argv) < 2:
+        print(__doc__)
+        sys.exit(1)
+    path = sys.argv[1]
+    out_prefix = sys.argv[2] if len(sys.argv) > 2 else (
+        os.path.splitext(path)[0])
+
+    if path.endswith(".jsonl"):
+        epochs = parse_jsonl(path)
+    else:
+        epochs = parse_stdout_log(path)
+    if not epochs:
+        print("no epochs found in log")
+        sys.exit(1)
+    print(f"parsed {len(epochs)} epochs")
+    plot(epochs, out_prefix)
+
+
+if __name__ == "__main__":
+    main()
