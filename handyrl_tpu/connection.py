@@ -168,8 +168,8 @@ class MultiProcessJobExecutor:
     def shutdown(self):
         self.shutdown_flag = True
 
-    def recv(self):
-        return self.output_queue.get()
+    def recv(self, timeout=None):
+        return self.output_queue.get(timeout=timeout)
 
     def start(self):
         self.threads.append(

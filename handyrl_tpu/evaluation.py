@@ -384,15 +384,24 @@ def eval_server_main(args, argv):
 
 def eval_client_main(args, argv):
     print("network match client mode")
+    from .connection import _mp
+
+    procs, conns = [], []
     while True:
         try:
             host = argv[1] if len(argv) >= 2 else "localhost"
             conn = open_socket_connection(host, NETWORK_PORT)
             env_args = conn.recv()
-        except EOFError:
+        except (EOFError, ConnectionError, OSError):
             break
 
         model_path = argv[0] if len(argv) >= 1 else "models/latest.ckpt"
-        mp.Process(target=client_mp_child,
-                   args=(env_args, model_path, conn), daemon=True).start()
-        conn.close()
+        p = _mp.Process(target=client_mp_child,
+                        args=(env_args, model_path, conn), daemon=True)
+        p.start()
+        procs.append(p)
+        # keep our copy open: spawned children receive the socket via
+        # the resource sharer, which needs the parent fd alive
+        conns.append(conn)
+    for p in procs:
+        p.join()

@@ -144,8 +144,8 @@ class Batcher:
             "total": ep["steps"],
         }
 
-    def batch(self):
-        return self.executor.recv()
+    def batch(self, timeout=None):
+        return self.executor.recv(timeout=timeout)
 
     def shutdown(self):
         self.executor.shutdown()
@@ -165,6 +165,7 @@ class Trainer:
         self.epoch = args.get("restart_epoch", 0)
         self.steps = 0
         self.update_flag = False
+        self.shutdown_flag = False
         self.update_queue = queue.Queue(maxsize=1)
         self.batcher = Batcher(self.args, self.episodes)
 
@@ -240,7 +241,12 @@ class Trainer:
         metric_acc = []
 
         while batch_cnt == 0 or not self.update_flag:
-            batch = self.batcher.batch()
+            if self.shutdown_flag:
+                return None
+            try:
+                batch = self.batcher.batch(timeout=0.3)
+            except queue.Empty:
+                continue
             self.params, self.opt_state, metrics = self.update_step(
                 self.params, self.opt_state, batch)
             # keep metrics on device; sync once per epoch
@@ -276,17 +282,31 @@ class Trainer:
             pass
         return snapshot
 
+    def shutdown(self):
+        """Stop the training thread (checked between batches)."""
+        self.shutdown_flag = True
+        self.batcher.shutdown()
+
     def run(self):
         print("waiting training")
         while len(self.episodes) < self.args["minimum_episodes"]:
+            if self.shutdown_flag:
+                return
             time.sleep(1)
         if self.optimizer is not None:
             self.batcher.run()
             print("started training")
-        while True:
+        while not self.shutdown_flag:
             model = self.train()
+            if model is None:
+                break
             self.update_flag = False
-            self.update_queue.put((model, self.steps))
+            while not self.shutdown_flag:
+                try:
+                    self.update_queue.put((model, self.steps), timeout=0.3)
+                    break
+                except queue.Full:
+                    continue
 
 
 class Learner:
@@ -527,9 +547,18 @@ class Learner:
         return pickle.dumps(model)
 
     def run(self):
-        threading.Thread(target=self.trainer.run, daemon=True).start()
+        trainer_thread = threading.Thread(
+            target=self.trainer.run, daemon=True)
+        trainer_thread.start()
         self.worker.run()
-        self.server()
+        try:
+            self.server()
+        finally:
+            # stop device work before interpreter teardown: a daemon
+            # thread mid-update during exit crashes the XLA runtime
+            self.trainer.shutdown()
+            trainer_thread.join(timeout=30)
+            self.worker.shutdown()
 
 
 def train_main(args):

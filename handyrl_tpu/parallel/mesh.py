@@ -55,11 +55,11 @@ def make_mesh(spec: Optional[MeshSpec] = None, devices=None) -> Mesh:
     devices = list(devices if devices is not None else jax.devices())
     if spec is None:
         spec = MeshSpec(dp=len(devices))
-    if spec.size != len(devices):
+    if spec.size > len(devices):
         raise ValueError(
             f"mesh {spec.shape()} needs {spec.size} devices, have {len(devices)}"
         )
-    dev_array = np.asarray(devices).reshape(spec.shape())
+    dev_array = np.asarray(devices[:spec.size]).reshape(spec.shape())
     return Mesh(dev_array, AXES)
 
 

@@ -88,11 +88,14 @@ class Worker:
         return model_pool
 
     def run(self):
+        try:
+            self._loop()
+        except (ConnectionResetError, BrokenPipeError, EOFError, OSError):
+            pass  # learner/gather is gone: exit quietly
+
+    def _loop(self):
         while True:
-            try:
-                args = send_recv(self.conn, ("args", None))
-            except (ConnectionResetError, BrokenPipeError, EOFError, OSError):
-                break  # learner/gather is gone: exit quietly
+            args = send_recv(self.conn, ("args", None))
             if args is None:
                 break
             role = args["role"]
@@ -100,11 +103,7 @@ class Worker:
             models = {}
             if "model_id" in args:
                 model_ids = list(args["model_id"].values())
-                try:
-                    model_pool = self._gather_models(model_ids)
-                except (ConnectionResetError, BrokenPipeError, EOFError,
-                        OSError):
-                    break  # learner/gather is gone: exit quietly
+                model_pool = self._gather_models(model_ids)
                 for p, model_id in args["model_id"].items():
                     models[p] = model_pool[model_id]
 

@@ -1,0 +1,146 @@
+"""Distributed-path integration tests on one host.
+
+- learner with a dp=2 device mesh (virtual CPU devices)
+- remote workers joining a train server over localhost TCP
+- network battle eval server/client over the diff-sync protocol
+"""
+
+import multiprocessing as mp
+import os
+import pickle
+import threading
+import time
+
+import pytest
+
+TRAIN_ARGS = {
+    "turn_based_training": True,
+    "observation": False,
+    "gamma": 0.8,
+    "forward_steps": 4,
+    "burn_in_steps": 0,
+    "compress_steps": 4,
+    "entropy_regularization": 0.1,
+    "entropy_regularization_decay": 0.1,
+    "update_episodes": 12,
+    "batch_size": 4,
+    "minimum_episodes": 8,
+    "maximum_episodes": 200,
+    "epochs": 1,
+    "num_batchers": 1,
+    "eval_rate": 0.1,
+    "worker": {"num_parallel": 2},
+    "lambda": 0.7,
+    "policy_target": "TD",
+    "value_target": "TD",
+    "seed": 2,
+}
+
+
+@pytest.mark.slow
+def test_learner_with_dp_mesh(tmp_path, monkeypatch):
+    """Full local training with the update step sharded over dp=2."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    monkeypatch.chdir(tmp_path)
+
+    args = {
+        "env_args": {"env": "TicTacToe"},
+        "train_args": {**TRAIN_ARGS, "mesh": {"dp": 2}},
+        "worker_args": {"num_parallel": 2, "server_address": ""},
+    }
+    from handyrl_tpu.learner import Learner
+
+    learner = Learner(args)
+    learner.run()
+    assert learner.model_epoch == 1
+    assert os.path.exists("models/1.ckpt")
+
+
+def _run_remote_workers(n):
+    from handyrl_tpu.worker import worker_main
+
+    args = {"worker_args": {
+        "server_address": "127.0.0.1", "num_parallel": n}}
+    worker_main(args, [])
+
+
+@pytest.mark.slow
+def test_train_server_with_remote_workers(tmp_path, monkeypatch):
+    """Learner in --train-server mode; a worker machine joins over TCP."""
+    monkeypatch.chdir(tmp_path)
+
+    args = {
+        "env_args": {"env": "TicTacToe"},
+        "train_args": dict(TRAIN_ARGS),
+        "worker_args": {"num_parallel": 2,
+                        "server_address": "127.0.0.1"},
+    }
+    from handyrl_tpu.learner import Learner
+
+    learner = Learner(args, remote=True)
+
+    # worker machine joins after the server is up (elastic join)
+    ctx = mp.get_context("spawn")
+    worker_proc = ctx.Process(
+        target=_run_remote_workers, args=(2,), daemon=False)
+
+    def delayed_join():
+        time.sleep(2)
+        worker_proc.start()
+
+    threading.Thread(target=delayed_join, daemon=True).start()
+    learner.run()
+
+    assert learner.model_epoch == 1
+    assert os.path.exists("models/1.ckpt")
+    worker_proc.terminate()
+    worker_proc.join(timeout=10)
+
+
+def _eval_client(model_path):
+    from handyrl_tpu.evaluation import eval_client_main
+
+    args = {"env_args": {"env": "TicTacToe"}}
+    eval_client_main(args, [model_path, "127.0.0.1"])
+
+
+@pytest.mark.slow
+def test_network_battle(tmp_path, monkeypatch):
+    """eval-server hosts the env; two clients drive agents over TCP."""
+    monkeypatch.chdir(tmp_path)
+
+    # make a checkpoint for the clients to load
+    from handyrl_tpu.envs.tictactoe import Environment as TicTacToe
+    from handyrl_tpu.models import TPUModel
+
+    env = TicTacToe()
+    env.reset()
+    model = TPUModel(env.net())
+    model.init_params(env.observation(0))
+    os.makedirs("models", exist_ok=True)
+    with open("models/latest.ckpt", "wb") as f:
+        pickle.dump({"params": model.params, "epoch": 1}, f)
+
+    # clients spawn their own match children, so they cannot be daemonic
+    ctx = mp.get_context("spawn")
+    clients = [
+        ctx.Process(target=_eval_client, args=("models/latest.ckpt",))
+        for _ in range(2)
+    ]
+
+    def delayed_clients():
+        time.sleep(2)
+        for c in clients:
+            c.start()
+
+    threading.Thread(target=delayed_clients, daemon=True).start()
+
+    from handyrl_tpu.evaluation import evaluate_mp
+
+    evaluate_mp(env, [None, None], None, {"env": "TicTacToe"},
+                {"default": {}}, 1, 4, seed=0)
+    for c in clients:
+        c.terminate()
