@@ -1,8 +1,13 @@
-"""Inference-time agents for evaluation and match play.
+"""Inference-time policies used by evaluation and network battles.
 
-Parity with /root/reference/handyrl/agent.py:13-112: random, rule-based
-(delegating to ``env.rule_based_action``), greedy/soft neural agents,
-and a mean-ensemble over multiple models.
+Capability parity with the reference agent layer
+(/root/reference/handyrl/agent.py): uniform-random play, rule-based
+play delegating to the env, greedy/sampled neural policies, and a
+model ensemble.  The ``reset / action / observe`` surface is the
+framework's evaluation contract; the internals here are organized
+around one shared piece of policy math (`masked_logits` +
+`sample_action`) that the Generator reuses, so actor-side action
+selection has a single implementation.
 """
 
 import random
@@ -11,8 +16,61 @@ import numpy as np
 
 from .utils.tree import softmax_np
 
+# Logit penalty that guarantees illegal actions never win an argmax or
+# receive softmax mass in float32.
+ILLEGAL = 1e32
+
+
+def masked_logits(logits, legal_actions):
+    """Return a copy of ``logits`` with illegal entries pushed to -inf
+    scale, so downstream softmax/argmax see only legal actions."""
+    masked = np.full_like(logits, -ILLEGAL)
+    masked[legal_actions] = logits[legal_actions]
+    return masked
+
+
+def sample_action(logits, legal_actions, temperature=1.0):
+    """Pick an action from masked ``logits``.
+
+    ``temperature == 0`` is greedy; otherwise a softmax draw at that
+    temperature.  Returns ``(action, probs)`` where ``probs`` is the
+    temperature-1 masked distribution (the behavior policy recorded
+    for importance sampling).
+    """
+    masked = masked_logits(logits, legal_actions)
+    probs = softmax_np(masked)
+    if temperature == 0:
+        action = int(np.argmax(masked))
+    elif temperature == 1.0:
+        action = random.choices(legal_actions,
+                                weights=probs[legal_actions])[0]
+    else:
+        tempered = softmax_np(masked / temperature)
+        action = random.choices(legal_actions,
+                                weights=tempered[legal_actions])[0]
+    return int(action), probs
+
+
+def _render(env, probs, value):
+    """Human-readable dump of a policy/value pair (``show=True`` path);
+    envs may override via a ``print_outputs`` hook."""
+    if hasattr(env, "print_outputs"):
+        env.print_outputs(probs, value)
+        return
+    if value is not None:
+        print("v = %f" % value)
+    if probs is not None:
+        print("p = %s" % (probs * 1000).astype(int))
+
+
+# Back-compat alias: the reference exposes this helper by this name.
+def print_outputs(env, prob, v):
+    _render(env, prob, v)
+
 
 class RandomAgent:
+    """Uniform play over legal actions; the baseline opponent."""
+
     def reset(self, env, show=False):
         pass
 
@@ -24,27 +82,21 @@ class RandomAgent:
 
 
 class RuleBasedAgent(RandomAgent):
+    """Delegates to the env's scripted policy when it has one."""
+
     def __init__(self, key=None):
         self.key = key
 
     def action(self, env, player, show=False):
-        if hasattr(env, "rule_based_action"):
-            return env.rule_based_action(player, key=self.key)
-        return random.choice(env.legal_actions(player))
-
-
-def print_outputs(env, prob, v):
-    if hasattr(env, "print_outputs"):
-        env.print_outputs(prob, v)
-    else:
-        if v is not None:
-            print("v = %f" % v)
-        if prob is not None:
-            print("p = %s" % (prob * 1000).astype(int))
+        scripted = getattr(env, "rule_based_action", None)
+        if scripted is None:
+            return super().action(env, player, show)
+        return scripted(player, key=self.key)
 
 
 class Agent:
-    """Neural agent: argmax at temperature 0, else softmax sampling."""
+    """Neural policy over a TPUModel: greedy at temperature 0, else a
+    softmax draw; carries recurrent hidden state across the game."""
 
     def __init__(self, model, temperature=0.0, observation=True):
         self.model = model
@@ -61,49 +113,46 @@ class Agent:
         return outputs
 
     def action(self, env, player, show=False):
-        obs = env.observation(player)
-        outputs = self.plan(obs)
-        logits = outputs["policy"]
-        v = outputs.get("value", None)
+        outputs = self.plan(env.observation(player))
         legal = env.legal_actions(player)
-        mask = np.ones_like(logits)
-        mask[legal] = 0.0
-        logits = logits - mask * 1e32
-
+        action, probs = sample_action(
+            outputs["policy"], legal, self.temperature)
         if show:
-            print_outputs(env, softmax_np(logits), v)
-
-        if self.temperature == 0:
-            return max(legal, key=lambda a: logits[a])
-        probs = softmax_np(logits / self.temperature)
-        return random.choices(np.arange(len(logits)), weights=probs)[0]
+            _render(env, probs, outputs.get("value"))
+        return action
 
     def observe(self, env, player, show=False):
-        v = None
-        if self.observation:
-            outputs = self.plan(env.observation(player))
-            v = outputs.get("value", None)
-            if show:
-                print_outputs(env, None, v)
-        return v
+        if not self.observation:
+            return None
+        outputs = self.plan(env.observation(player))
+        value = outputs.get("value")
+        if show:
+            _render(env, None, value)
+        return value
 
 
 class EnsembleAgent(Agent):
+    """Averages head outputs across a list of models, each carrying its
+    own hidden state."""
+
     def reset(self, env, show=False):
-        self.hidden = [model.init_hidden() for model in self.model]
+        self.hidden = [m.init_hidden() for m in self.model]
 
     def plan(self, obs):
-        outputs = {}
+        per_model = []
         for i, model in enumerate(self.model):
             out = model.inference(obs, self.hidden[i])
-            for k, v in out.items():
-                if k == "hidden":
-                    self.hidden[i] = v
-                else:
-                    outputs.setdefault(k, []).append(v)
-        return {k: np.mean(v, axis=0) for k, v in outputs.items()}
+            self.hidden[i] = out.pop("hidden", None)
+            per_model.append(out)
+        keys = set().union(*(out.keys() for out in per_model))
+        return {
+            k: np.mean([out[k] for out in per_model if k in out], axis=0)
+            for k in keys
+        }
 
 
 class SoftAgent(Agent):
+    """Temperature-1 sampling — the exploration-matched eval agent."""
+
     def __init__(self, model):
         super().__init__(model, temperature=1.0)

@@ -1,11 +1,18 @@
 """Evaluation: online eval matches, offline eval driver, network battles.
 
-Role parity with /root/reference/handyrl/evaluation.py:32-436 — the
-online Evaluator used by workers during training, the multiprocess
-offline driver behind ``--eval`` (with first/second seat equalization
-for two-player games), and the network battle mode where a server hosts
-the env and remote clients drive agents over TCP via the env's
-``diff_info``/``update`` delta-sync protocol.
+Capability parity with the reference evaluation layer
+(/root/reference/handyrl/evaluation.py): the online Evaluator used by
+workers during training, the multiprocess offline driver behind
+``--eval`` (two-player seats equalized first/second), and the network
+battle mode where a server hosts the env and remote clients drive
+agents over TCP via the env's ``diff_info``/``update`` delta-sync
+protocol on port 9876.
+
+Protocol surfaces (fixed): the RPC verbs ``update / outcome / action /
+observe / quit``, the network port, and the result dict
+``{args, result, opponent}`` consumed by the learner.  The match
+drivers, seat scheduling, and result aggregation are organized
+framework-side here (ResultTable, _seat_plan).
 """
 
 import multiprocessing as mp
@@ -23,81 +30,108 @@ from .models import TPUModel
 NETWORK_PORT = 9876
 
 
+# ---------------------------------------------------------------------
+# network battle plumbing
+# ---------------------------------------------------------------------
+
 class NetworkAgentClient:
-    """Client side of a network battle: owns the agent and a mirror env,
-    executing RPC verbs sent by the server."""
+    """Client side of a network battle: owns a real agent plus a mirror
+    env kept in sync by the server's diff stream, and answers RPC verbs
+    until told to quit."""
 
     def __init__(self, agent, env, conn):
         self.conn = conn
         self.agent = agent
         self.env = env
 
+    def _on_update(self, data, reset):
+        self.env.update(data, reset)
+        print(self.env)
+        if reset:
+            # new game: recurrent agents must drop the old hidden state
+            self.agent.reset(self.env, show=True)
+        return None
+
+    def _on_action(self, player):
+        action = self.agent.action(self.env, player, show=True)
+        return self.env.action2str(action, player)
+
+    def _on_observe(self, player):
+        return self.agent.observe(self.env, player, show=True)
+
     def run(self):
         while True:
             try:
-                command, args = self.conn.recv()
+                verb, payload = self.conn.recv()
             except (ConnectionResetError, EOFError):
                 break
-            if command == "quit":
+            if verb == "quit":
                 break
-            elif command == "outcome":
-                print(f"outcome = {args[0]}")
-            elif hasattr(self.agent, command):
-                ret = getattr(self.agent, command)(self.env, *args, show=True)
-                if command == "action":
-                    player = args[0]
-                    ret = self.env.action2str(ret, player)
+            if verb == "outcome":
+                print(f"outcome = {payload[0]}")
+                reply = None
+            elif verb == "update":
+                reply = self._on_update(*payload)
+            elif verb == "action":
+                reply = self._on_action(*payload)
+            elif verb == "observe":
+                reply = self._on_observe(*payload)
             else:
-                ret = getattr(self.env, command)(*args)
-                if command == "update":
-                    print(self.env)
-            self.conn.send(ret)
+                reply = getattr(self.env, verb)(*payload)
+            self.conn.send(reply)
 
 
 class NetworkAgent:
-    """Server-side proxy: forwards verbs to a remote client agent."""
+    """Server-side stub forwarding agent verbs to a remote client."""
 
     def __init__(self, conn):
         self.conn = conn
 
-    def update(self, data, reset):
-        return self._send("update", [data, reset])
-
-    def outcome(self, outcome):
-        return self._send("outcome", [outcome])
-
-    def action(self, player):
-        return self._send("action", [player])
-
-    def observe(self, player):
-        return self._send("observe", [player])
-
-    def _send(self, command, args):
-        self.conn.send((command, args))
+    def _call(self, verb, *payload):
+        self.conn.send((verb, list(payload)))
         return self.conn.recv()
 
+    def update(self, data, reset):
+        return self._call("update", data, reset)
+
+    def outcome(self, outcome):
+        return self._call("outcome", outcome)
+
+    def action(self, player):
+        return self._call("action", player)
+
+    def observe(self, player):
+        return self._call("observe", player)
+
+
+# ---------------------------------------------------------------------
+# match drivers
+# ---------------------------------------------------------------------
 
 def exec_match(env, agents, critic=None, show=False, game_args={}):
-    """One match on a shared env instance; returns per-player outcome."""
+    """One match on a shared env instance; returns per-player outcome
+    or None on env failure."""
     if env.reset(game_args):
         return None
     for agent in agents.values():
         agent.reset(env, show=show)
+
     while not env.terminal():
         if show:
             print(env)
-        turn_players = env.turns()
-        observers = env.observers()
-        actions = {}
+        on_turn, watching = env.turns(), env.observers()
+        actions = {
+            p: agent.action(env, p, show=show)
+            for p, agent in agents.items() if p in on_turn
+        }
         for p, agent in agents.items():
-            if p in turn_players:
-                actions[p] = agent.action(env, p, show=show)
-            elif p in observers:
+            if p in watching and p not in on_turn:
                 agent.observe(env, p, show=show)
         if env.step(actions):
             return None
         if show and critic is not None:
             print(f"cv = {critic.observe(env, None, show=False)}")
+
     if show:
         print(env)
         print(f"final outcome = {env.outcome()}")
@@ -105,33 +139,38 @@ def exec_match(env, agents, critic=None, show=False, game_args={}):
 
 
 def exec_network_match(env, network_agents, critic=None, game_args={}):
-    """One match where agents live on remote clients, kept in sync by
+    """One match whose agents live on remote clients, kept in sync by
     the env's diff protocol."""
+
+    def broadcast_state(reset):
+        for p, agent in network_agents.items():
+            agent.update(env.diff_info(p), reset)
+
     if env.reset(game_args):
         return None
-    for p, agent in network_agents.items():
-        info = env.diff_info(p)
-        agent.update(info, True)
+    broadcast_state(reset=True)
+
     while not env.terminal():
-        turn_players = env.turns()
-        observers = env.observers()
+        on_turn, watching = env.turns(), env.observers()
         actions = {}
         for p, agent in network_agents.items():
-            if p in turn_players:
-                action_str = agent.action(p)
-                actions[p] = env.str2action(action_str, p)
-            elif p in observers:
+            if p in on_turn:
+                actions[p] = env.str2action(agent.action(p), p)
+            elif p in watching:
                 agent.observe(p)
         if env.step(actions):
             return None
-        for p, agent in network_agents.items():
-            info = env.diff_info(p)
-            agent.update(info, False)
+        broadcast_state(reset=False)
+
     outcome = env.outcome()
     for p, agent in network_agents.items():
         agent.outcome(outcome[p])
     return outcome
 
+
+# ---------------------------------------------------------------------
+# opponents + online evaluator
+# ---------------------------------------------------------------------
 
 def build_agent(raw, env=None):
     """Instantiate a named opponent: 'random', 'rulebase[-key]'."""
@@ -143,26 +182,39 @@ def build_agent(raw, env=None):
     return None
 
 
+def configured_opponents(args, prefer_cli=False):
+    """Opponent pool from config; resolves both the training-side
+    ``eval.opponent`` and the CLI-side ``eval_args.opponent`` spelling
+    in one place.  ``prefer_cli`` flips the priority for the ``--eval``
+    entry point, whose traditional key is ``eval_args``."""
+    keys = ["eval", "eval_args"]
+    if prefer_cli:
+        keys.reverse()
+    raw = (
+        args.get(keys[0], {}).get("opponent")
+        or args.get(keys[1], {}).get("opponent")
+        or ["random"]
+    )
+    return raw if isinstance(raw, list) else [raw]
+
+
 class Evaluator:
-    """Online evaluation during training: trained model vs configured
-    opponent pool (default 'random')."""
+    """Online evaluation during training: the current model in the
+    trained seats vs a configured opponent in the rest."""
 
     def __init__(self, env, args):
         self.env = env
         self.args = args
-        self.opponent = args.get("eval", {}).get("opponent", ["random"])
-        if not isinstance(self.opponent, list):
-            self.opponent = [self.opponent]
+        self.opponents = configured_opponents(args)
+
+    def _seat(self, model, opponent):
+        if model is None:
+            return build_agent(opponent, self.env) or RandomAgent()
+        return Agent(model, observation=self.args["observation"])
 
     def execute(self, models, args):
-        opponents = self.opponent
-        opponent = random.choice(opponents) if opponents else "random"
-        agents = {}
-        for p, model in models.items():
-            if model is None:
-                agents[p] = build_agent(opponent, self.env) or RandomAgent()
-            else:
-                agents[p] = Agent(model, observation=self.args["observation"])
+        opponent = random.choice(self.opponents)
+        agents = {p: self._seat(m, opponent) for p, m in models.items()}
         outcome = exec_match(self.env, agents)
         if outcome is None:
             print("None episode in evaluation!")
@@ -170,146 +222,159 @@ class Evaluator:
         return {"args": args, "result": outcome, "opponent": opponent}
 
 
+# ---------------------------------------------------------------------
+# offline evaluation farm
+# ---------------------------------------------------------------------
+
 def wp_func(results):
     """Win rate over an outcome histogram (draws count half)."""
     games = sum(results.values())
     if games == 0:
         return 0.0
-    win = sum(n for r, n in results.items() if r > 0)
-    draw = sum(n for r, n in results.items() if r == 0)
-    return (win + draw / 2) / games
+    wins = sum(n for outcome, n in results.items() if outcome > 0)
+    draws = sum(n for outcome, n in results.items() if outcome == 0)
+    return (wins + draws / 2) / games
 
 
-def eval_process_mp_child(agents, critic, env_args, index, in_queue, out_queue,
-                          seed, show=False):
+class ResultTable:
+    """Outcome histograms per agent, split by seat pattern."""
+
+    def __init__(self, num_agents):
+        self.by_pattern = [{} for _ in range(num_agents)]
+        self.overall = [{} for _ in range(num_agents)]
+
+    def add(self, players, agent_ids, pattern, outcome):
+        for seat, player in enumerate(players):
+            agent_id = agent_ids[seat]
+            oc = outcome[player]
+            histogram = self.by_pattern[agent_id].setdefault(pattern, {})
+            histogram[oc] = histogram.get(oc, 0) + 1
+            self.overall[agent_id][oc] = self.overall[agent_id].get(oc, 0) + 1
+
+    def report(self):
+        for agent_id, patterns in enumerate(self.by_pattern):
+            print(f"agent {agent_id}")
+            for pattern, histogram in patterns.items():
+                print(f"    pattern {pattern}: "
+                      f"win rate = {wp_func(histogram):.3f} "
+                      f"({sum(histogram.values())} games)")
+        for agent_id, histogram in enumerate(self.overall):
+            print(f"agent {agent_id}: win rate = {wp_func(histogram):.3f}")
+
+
+def _seat_plan(num_agents, num_games, pattern):
+    """Yield (agent_ids, pattern_tag) per game.  Two-agent series play
+    half the games with each agent moving first; larger pools are
+    shuffled per game."""
+    for g in range(num_games):
+        if num_agents == 2:
+            first = 0 if g < (num_games + 1) // 2 else 1
+            tag = f"{pattern}_{'first' if first == 0 else 'second'}"
+            yield [first, 1 - first], tag
+        else:
+            yield random.sample(range(num_agents), num_agents), pattern
+
+
+def _match_series_child(agents, critic, env_args, index, in_queue,
+                        out_queue, seed, show=False):
+    """One eval process: drain the job queue, play, report outcomes."""
     from .connection import force_cpu_jax
 
     force_cpu_jax()
     random.seed(seed + index)
     env = make_env({**env_args, "id": index})
     while True:
-        args = in_queue.get()
-        if args is None:
+        job = in_queue.get()
+        if job is None:
             break
-        g, agent_ids, pat_idx, game_args = args
-        print(f"*** Game {g} ***")
-        agent_map = {
-            env.players()[p]: agents[ai] for p, ai in enumerate(agent_ids)
+        game_index, agent_ids, pattern, game_args = job
+        print(f"*** Game {game_index} ***")
+        seats = {
+            env.players()[seat]: agents[agent_id]
+            for seat, agent_id in enumerate(agent_ids)
         }
-        if isinstance(list(agent_map.values())[0], NetworkAgent):
-            outcome = exec_network_match(env, agent_map, critic,
+        remote = isinstance(next(iter(seats.values())), NetworkAgent)
+        if remote:
+            outcome = exec_network_match(env, seats, critic,
                                          game_args=game_args)
         else:
-            outcome = exec_match(env, agent_map, critic, show=show,
+            outcome = exec_match(env, seats, critic, show=show,
                                  game_args=game_args)
-        out_queue.put((pat_idx, agent_ids, outcome))
+        out_queue.put((pattern, agent_ids, outcome))
     out_queue.put(None)
 
 
 def evaluate_mp(env, agents, critic, env_args, args_patterns, num_process,
                 num_games, seed):
     """Offline evaluation farm: ``num_process`` processes play
-    ``num_games`` per pattern; two-player seats are equalized."""
+    ``num_games`` per pattern; outcomes land in a ResultTable."""
     from .connection import _mp
 
     in_queue, out_queue = _mp.Queue(), _mp.Queue()
-    args_cnt = 0
-    total_results, result_map = [{} for _ in agents], [{} for _ in agents]
     print("total games = %d" % (len(args_patterns) * num_games))
     time.sleep(0.1)
-    for pat_name, game_args in args_patterns.items():
-        for i in range(num_games):
-            if len(agents) == 2:
-                # first/second seat equalization
-                first_agent = 0 if i < (num_games + 1) // 2 else 1
-                seat = "first" if first_agent == 0 else "second"
-                tmp_pat_idx = f"{pat_name}_{seat}"
-                agent_ids = [first_agent, 1 - first_agent]
-            else:
-                tmp_pat_idx = pat_name
-                agent_ids = random.sample(
-                    list(range(len(agents))), len(agents))
-            in_queue.put((args_cnt, agent_ids, tmp_pat_idx, game_args))
-            args_cnt += 1
+
+    jobs = 0
+    for pattern, game_args in args_patterns.items():
+        for agent_ids, tag in _seat_plan(len(agents), num_games, pattern):
+            in_queue.put((jobs, agent_ids, tag, game_args))
+            jobs += 1
 
     network_mode = agents[0] is None
-    if network_mode:  # network battle mode
-        agents = network_match_acception(
+    if network_mode:
+        per_process_agents = network_match_acception(
             num_process, env_args, len(agents), NETWORK_PORT)
     else:
-        agents = [agents] * num_process
+        per_process_agents = [agents] * num_process
 
     for i in range(num_process):
         in_queue.put(None)
-        args = (agents[i], critic, env_args, i, in_queue, out_queue, seed)
+        child_args = (per_process_agents[i], critic, env_args, i,
+                      in_queue, out_queue, seed)
         if num_process > 1:
-            _mp.Process(target=eval_process_mp_child, args=args,
+            _mp.Process(target=_match_series_child, args=child_args,
                         daemon=True).start()
             if network_mode:
-                for agent in agents[i]:
+                for agent in per_process_agents[i]:
                     agent.conn.close()
         else:
-            eval_process_mp_child(*args, show=True)
+            _match_series_child(*child_args, show=True)
 
-    finished_cnt = 0
-    while finished_cnt < num_process:
-        ret = out_queue.get()
-        if ret is None:
-            finished_cnt += 1
+    table = ResultTable(len(agents))
+    live_children = num_process
+    while live_children > 0:
+        item = out_queue.get()
+        if item is None:
+            live_children -= 1
             continue
-        pat_idx, agent_ids, outcome = ret
+        pattern, agent_ids, outcome = item
         if outcome is not None:
-            for idx, p in enumerate(env.players()):
-                agent_id = agent_ids[idx]
-                oc = outcome[p]
-                result_map[agent_id].setdefault(pat_idx, {})
-                result_map[agent_id][pat_idx][oc] = (
-                    result_map[agent_id][pat_idx].get(oc, 0) + 1)
-                total_results[agent_id][oc] = (
-                    total_results[agent_id].get(oc, 0) + 1)
-
-    for idx, result in enumerate(result_map):
-        print(f"agent {idx}")
-        for pat_idx, results in result.items():
-            print(f"    pattern {pat_idx}: "
-                  f"win rate = {wp_func(results):.3f} "
-                  f"({sum(results.values())} games)")
-    for idx, results in enumerate(total_results):
-        print(f"agent {idx}: win rate = {wp_func(results):.3f}")
+            table.add(env.players(), agent_ids, pattern, outcome)
+    table.report()
 
 
 def network_match_acception(n, env_args, num_agents, port):
-    """Accept ``n * num_agents`` client connections and group them into
-    per-match agent lists."""
-    waiting_conns = []
-    accepted_conns = []
-
+    """Accept ``n * num_agents`` client connections, grouping them in
+    arrival order into per-match agent lists.  Every accepted client is
+    sent the env args (its handshake to start mirroring the env)."""
+    matches = []
+    current = []
     for conn in accept_socket_connections(port):
         if conn is None:
             continue
-        waiting_conns.append(conn)
-        if len(waiting_conns) == num_agents:
-            conn = waiting_conns[0]
-            accepted_conns.append(conn)
-            waiting_conns = waiting_conns[1:]
-            conn.send(env_args)  # send accepted env args
-
-        if len(accepted_conns) >= n * num_agents:
+        conn.send(env_args)
+        current.append(conn)
+        if len(current) == num_agents:
+            matches.append([NetworkAgent(c) for c in current])
+            current = []
+        if len(matches) >= n:
             break
-
-    agents_list = [
-        [NetworkAgent(accepted_conns[i * num_agents + j])
-         for j in range(num_agents)]
-        for i in range(n)
-    ]
-    return agents_list
+    return matches
 
 
-def client_mp_child(env_args, model_path, conn):
-    env = make_env(env_args)
-    model = load_model(model_path, env)
-    NetworkAgentClient(Agent(model), env, conn).run()
-
+# ---------------------------------------------------------------------
+# model loading + CLI entry points
+# ---------------------------------------------------------------------
 
 def load_model(model_path, env):
     """Load a saved checkpoint (.ckpt pickle or exported .npz) into a
@@ -336,6 +401,14 @@ def load_model(model_path, env):
     return model
 
 
+def _resolve_agent(raw, env):
+    """A CLI agent spec: a named opponent or a checkpoint path."""
+    agent = build_agent(raw, env)
+    if agent is None:
+        agent = Agent(load_model(raw, env))
+    return agent
+
+
 def eval_main(args, argv):
     env_args = args["env_args"]
     prepare_env(env_args)
@@ -345,25 +418,17 @@ def eval_main(args, argv):
     num_games = int(argv[1]) if len(argv) >= 2 else 100
     num_process = int(argv[2]) if len(argv) >= 3 else 1
 
-    def resolve_agent(raw):
-        agent = build_agent(raw, env)
-        if agent is None:
-            model = load_model(raw, env)
-            agent = Agent(model)
-        return agent
-
-    agent1 = resolve_agent(model_path)
-    critic = None
+    main_agent = _resolve_agent(model_path, env)
     print(f"evaluated files = {model_path}")
 
     seed = random.randrange(1 << 31)
     print(f"seed = {seed}")
-    opponent = args.get("eval_args", {}).get("opponent", "random")
-    agents = [agent1] + [
+    opponent = configured_opponents(args, prefer_cli=True)[0]
+    agents = [main_agent] + [
         build_agent(opponent, env) or RandomAgent()
         for _ in range(len(env.players()) - 1)
     ]
-    evaluate_mp(env, agents, critic, env_args, {"default": {}},
+    evaluate_mp(env, agents, None, env_args, {"default": {}},
                 num_process, num_games, seed)
 
 
@@ -380,6 +445,12 @@ def eval_server_main(args, argv):
     print(f"seed = {seed}")
     evaluate_mp(env, [None] * len(env.players()), None, env_args,
                 {"default": {}}, num_process, num_games, seed)
+
+
+def client_mp_child(env_args, model_path, conn):
+    env = make_env(env_args)
+    model = load_model(model_path, env)
+    NetworkAgentClient(Agent(model), env, conn).run()
 
 
 def eval_client_main(args, argv):

@@ -1,11 +1,14 @@
-"""Self-play episode generation (the actor-side hot loop).
+"""Self-play episode generation — the actor-side hot loop.
 
-Semantic parity with /root/reference/handyrl/generation.py:20-99: per
-player recurrent hidden state, per-step inference for turn players and
-observers, legal-action masking (illegal logits pushed down by 1e32),
-softmax sampling with the behavior probability recorded for importance
-sampling, immediate rewards, backward discounted returns, and the
-episode packed as bz2-compressed moment blocks.
+Produces the framework's episode wire format (capability parity with
+/root/reference/handyrl/generation.py): per-step "moment" dicts keyed
+by channel then player, bz2-pickled in blocks of ``compress_steps``,
+plus the final outcome and the job args that produced the episode.
+The moment schema is protocol — the batch maker consumes it — but the
+rollout here is organized differently from the reference: each player
+gets a ``Seat`` owning its model + recurrent state, the step loop asks
+seats to think/act, and discounted returns are filled in by one
+vectorized numpy backward pass over the whole episode.
 
 Runs in CPU actor processes; ``models`` are TPUModel/RandomModel
 instances whose ``inference`` is a CPU-jitted forward.
@@ -13,11 +16,10 @@ instances whose ``inference`` is a CPU-jitted forward.
 
 import bz2
 import pickle
-import random
 
 import numpy as np
 
-from .utils.tree import softmax_np
+from .agent import ILLEGAL, sample_action
 
 MOMENT_KEYS = (
     "observation", "selected_prob", "action_mask", "action",
@@ -25,93 +27,131 @@ MOMENT_KEYS = (
 )
 
 
+class Seat:
+    """One player's acting state inside a single episode."""
+
+    __slots__ = ("player", "model", "hidden")
+
+    def __init__(self, player, model):
+        self.player = player
+        self.model = model
+        self.hidden = model.init_hidden()
+
+    def think(self, obs):
+        """Run inference, carrying the recurrent state forward."""
+        outputs = self.model.inference(obs, self.hidden)
+        self.hidden = outputs.pop("hidden", None)
+        return outputs
+
+
 class Generator:
+    """Plays full self-play episodes and packs them for the wire."""
+
     def __init__(self, env, args):
         self.env = env
         self.args = args
 
-    def generate(self, models, args):
-        """Play one self-play episode; returns None on env failure."""
-        moments = []
-        hidden = {p: models[p].init_hidden() for p in self.env.players()}
+    # -- one step ----------------------------------------------------
+    def _blank_moment(self):
+        players = self.env.players()
+        return {key: {p: None for p in players} for key in MOMENT_KEYS}
 
-        if self.env.reset():
+    def _participants(self, trained_players):
+        """Players that run inference this step: everyone on turn, plus
+        observers — except trained off-turn players when the config
+        does not keep their RNN state warm (``observation`` flag)."""
+        on_turn = self.env.turns()
+        watching = []
+        for p in self.env.observers():
+            if p in on_turn:
+                continue
+            if p in trained_players and not self.args["observation"]:
+                continue
+            watching.append(p)
+        return on_turn, watching
+
+    def _step(self, seats, trained_players):
+        """Advance the env by one move; returns the recorded moment or
+        None if the env reports an error."""
+        moment = self._blank_moment()
+        on_turn, watching = self._participants(trained_players)
+
+        for player in list(on_turn) + watching:
+            seat = seats[player]
+            obs = self.env.observation(player)
+            outputs = seat.think(obs)
+            moment["observation"][player] = obs
+
+            value = outputs.get("value")
+            if value is not None:
+                moment["value"][player] = np.ravel(
+                    np.asarray(value, np.float32))
+
+            if player in on_turn:
+                legal = self.env.legal_actions(player)
+                action, probs = sample_action(outputs["policy"], legal)
+                mask = np.full_like(outputs["policy"], ILLEGAL)
+                mask[legal] = 0.0
+                moment["action"][player] = action
+                moment["selected_prob"][player] = float(probs[action])
+                moment["action_mask"][player] = mask
+
+        if self.env.step(moment["action"]):
             return None
 
-        while not self.env.terminal():
-            moment = {
-                key: {p: None for p in self.env.players()}
-                for key in MOMENT_KEYS
-            }
+        rewards = self.env.reward()
+        for p in self.env.players():
+            moment["reward"][p] = rewards.get(p)
+        moment["turn"] = on_turn
+        return moment
 
-            turn_players = self.env.turns()
-            observers = self.env.observers()
-            for player in self.env.players():
-                if player not in turn_players + observers:
-                    continue
-                if (
-                    player not in turn_players
-                    and player in args["player"]
-                    and not self.args["observation"]
-                ):
-                    # trained non-turn players only observe when the
-                    # observation flag asks for RNN state upkeep
-                    continue
+    # -- returns + packing -------------------------------------------
+    def _fill_returns(self, moments):
+        """Discounted return per player, one vectorized backward pass:
+        R[t] = r[t] + gamma * R[t+1] over a (T, P) reward matrix."""
+        players = self.env.players()
+        rewards = np.asarray(
+            [[m["reward"][p] or 0.0 for p in players] for m in moments],
+            dtype=np.float64)
+        acc = np.zeros(len(players))
+        for t in range(len(moments) - 1, -1, -1):
+            acc = rewards[t] + self.args["gamma"] * acc
+            returns = moments[t]["return"]
+            for i, p in enumerate(players):
+                returns[p] = acc[i]
 
-                obs = self.env.observation(player)
-                outputs = models[player].inference(obs, hidden[player])
-                hidden[player] = outputs.get("hidden", None)
-
-                moment["observation"][player] = obs
-                value = outputs.get("value", None)
-                if value is not None:
-                    moment["value"][player] = np.ravel(
-                        np.asarray(value, np.float32)
-                    )
-
-                if player in turn_players:
-                    logits = outputs["policy"]
-                    legal = self.env.legal_actions(player)
-                    mask = np.full_like(logits, 1e32)
-                    mask[legal] = 0.0
-                    probs = softmax_np(logits - mask)
-                    action = random.choices(legal, weights=probs[legal])[0]
-
-                    moment["selected_prob"][player] = float(probs[action])
-                    moment["action_mask"][player] = mask
-                    moment["action"][player] = int(action)
-
-            if self.env.step(moment["action"]):
-                return None
-
-            reward = self.env.reward()
-            for player in self.env.players():
-                moment["reward"][player] = reward.get(player, None)
-
-            moment["turn"] = turn_players
-            moments.append(moment)
-
-        if not moments:
-            return None
-
-        # backward pass: discounted return per player
-        gamma = self.args["gamma"]
-        for player in self.env.players():
-            ret = 0.0
-            for m in reversed(moments):
-                ret = (m["reward"][player] or 0.0) + gamma * ret
-                m["return"][player] = ret
-
-        compress = self.args["compress_steps"]
+    def _pack(self, moments, job_args):
+        block = self.args["compress_steps"]
         return {
-            "args": args,
+            "args": job_args,
             "steps": len(moments),
             "outcome": self.env.outcome(),
             "moment": [
-                bz2.compress(pickle.dumps(moments[i: i + compress]))
-                for i in range(0, len(moments), compress)
+                bz2.compress(pickle.dumps(moments[lo: lo + block]))
+                for lo in range(0, len(moments), block)
             ],
         }
+
+    # -- entry points ------------------------------------------------
+    def generate(self, models, args):
+        """Play one episode; returns the packed episode, or None when
+        the env signals a reset/step failure."""
+        if self.env.reset():
+            return None
+        seats = {p: Seat(p, models[p]) for p in self.env.players()}
+        trained_players = args["player"]
+
+        moments = []
+        while not self.env.terminal():
+            moment = self._step(seats, trained_players)
+            if moment is None:
+                return None
+            moments.append(moment)
+        if not moments:
+            return None
+
+        self._fill_returns(moments)
+        return self._pack(moments, args)
 
     def execute(self, models, args):
         episode = self.generate(models, args)

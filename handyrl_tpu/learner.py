@@ -196,11 +196,18 @@ class Trainer:
             print("train state is for epoch %s, not %d: cold-starting"
                   % (state.get("epoch"), restart_epoch))
             return
-        self.opt_state = jax.tree.map(
-            lambda like, saved: jax.numpy.asarray(saved),
-            self.opt_state, state["opt_state"])
-        self.steps = state["steps"]
-        self.data_cnt_ema = state["data_cnt_ema"]
+        try:
+            self.opt_state = jax.tree.map(
+                lambda like, saved: jax.numpy.asarray(saved),
+                self.opt_state, state["opt_state"])
+            self.steps = state["steps"]
+            self.data_cnt_ema = state["data_cnt_ema"]
+        except (ValueError, TypeError, KeyError):
+            # pytree structure changed (e.g. the net was modified
+            # between runs): cold-start rather than crash at startup
+            print("train state does not match the current model: "
+                  "cold-starting the optimizer")
+            return
         print(f"restored optimizer state at step {self.steps}")
 
     def save_train_state(self, epoch):
@@ -215,8 +222,29 @@ class Trainer:
             pickle.dump(state, f)
         os.replace(tmp, train_state_path())
 
+    def _default_mesh_cfg(self):
+        """With no mesh configured on a multi-device host, default to
+        pure data parallelism over as many devices as divide the batch
+        (the reference auto-engages DataParallel the same way)."""
+        n_dev = jax.device_count()
+        if n_dev <= 1:
+            return {}
+        import math
+
+        dp = math.gcd(self.args["batch_size"], n_dev)
+        if dp <= 1:
+            print(f"1 of {n_dev} devices used: batch_size "
+                  f"{self.args['batch_size']} has no common factor")
+            return {}
+        print(f"defaulting to dp={dp} over {n_dev} devices")
+        return {"dp": dp}
+
     def _build_update_step(self):
         mesh_cfg = self.args.get("mesh") or {}
+        if not mesh_cfg:
+            # only auto-shard when the user left mesh unset; an explicit
+            # all-ones mesh (e.g. {dp: 1}) forces the unsharded step
+            mesh_cfg = self._default_mesh_cfg()
         if mesh_cfg and any(int(v) > 1 for v in mesh_cfg.values()):
             from .parallel import MeshSpec, make_mesh, make_sharded_update_step
 
@@ -309,6 +337,68 @@ class Trainer:
                     continue
 
 
+class RunningScore:
+    """Streaming count/mean/std accumulator for outcome streams."""
+
+    __slots__ = ("n", "total", "total_sq")
+
+    def __init__(self):
+        self.n = 0
+        self.total = 0.0
+        self.total_sq = 0.0
+
+    def add(self, x):
+        self.n += 1
+        self.total += x
+        self.total_sq += x * x
+
+    @property
+    def mean(self):
+        return self.total / (self.n + 1e-6)
+
+    @property
+    def std(self):
+        return max(0.0, self.total_sq / (self.n + 1e-6)
+                   - self.mean ** 2) ** 0.5
+
+    @property
+    def win_rate(self):
+        """Outcome in [-1, 1] mapped to a win probability."""
+        return (self.mean + 1) / 2
+
+
+class ReplayBuffer:
+    """Episode deque shared with the Trainer, trimmed to the configured
+    cap — or tighter under host-RAM pressure."""
+
+    def __init__(self, episodes, maximum_episodes):
+        self.episodes = episodes  # the Trainer's deque (shared)
+        self.maximum_episodes = maximum_episodes
+        self.warned = False
+
+    def extend(self, episodes):
+        self.episodes.extend(episodes)
+        self._trim()
+
+    def _cap(self):
+        mem_percent = psutil.virtual_memory().percent if psutil else 0.0
+        if mem_percent <= 95:
+            return self.maximum_episodes
+        if not self.warned:
+            import warnings
+
+            warnings.warn(
+                "memory usage %.1f%% with buffer size %d"
+                % (mem_percent, len(self.episodes)))
+            self.warned = True
+        return int(len(self.episodes) * 95 / mem_percent)
+
+    def _trim(self):
+        cap = self._cap()
+        while len(self.episodes) > cap:
+            self.episodes.popleft()
+
+
 class Learner:
     """Central conductor: owns the replay buffer, serves worker
     requests, reports stats, and checkpoints every epoch."""
@@ -324,41 +414,42 @@ class Learner:
         random.seed(self.args["seed"])
 
         self.env = make_env(env_args)
-        eval_modify_rate = (
-            self.args["update_episodes"] ** 0.85
-        ) / self.args["update_episodes"]
-        self.eval_rate = max(self.args["eval_rate"], eval_modify_rate)
+        # guarantee at least ~update_episodes^0.85 eval games per epoch
+        floor = self.args["update_episodes"] ** -0.15
+        self.eval_rate = max(self.args["eval_rate"], floor)
         self.shutdown_flag = False
-        self.flags = set()
 
-        # trained datum
         self.model_epoch = self.args["restart_epoch"]
-        if net is not None:
-            self.model = net if isinstance(net, TPUModel) else TPUModel(net)
-        else:
-            self.model = TPUModel(self.env.net())
-        if self.model.params is None:
-            self.env.reset()
-            obs = self.env.observation(self.env.players()[0])
-            self.model.init_params(obs, seed=self.args["seed"])
-        if self.model_epoch > 0:
-            with open(model_path(self.model_epoch), "rb") as f:
-                self.model.params = pickle.load(f)["params"]
+        self.model = self._initial_model(net)
 
-        # generated datum
-        self.generation_results = {}
-        self.num_episodes = 0
-        self.num_returned_episodes = 0
-
-        # evaluated datum
-        self.results = {}
-        self.results_per_opponent = {}
-        self.num_results = 0
+        # per-model-id outcome streams
+        self.generation_stats = {}
+        self.eval_stats = {}           # model_id -> RunningScore
+        self.eval_stats_by_opponent = {}  # model_id -> {name: RunningScore}
+        self.jobs_generated = 0
+        self.jobs_evaluated = 0
+        self.episodes_received = 0
 
         self.worker = WorkerServer(self.args) if remote \
             else WorkerCluster(self.args)
         self.trainer = Trainer(self.args, self.model)
+        self.replay = ReplayBuffer(
+            self.trainer.episodes, self.args["maximum_episodes"])
         self.metrics_path = self.args.get("metrics_path") or ""
+
+    def _initial_model(self, net):
+        if net is not None:
+            model = net if isinstance(net, TPUModel) else TPUModel(net)
+        else:
+            model = TPUModel(self.env.net())
+        if model.params is None:
+            self.env.reset()
+            obs = self.env.observation(self.env.players()[0])
+            model.init_params(obs, seed=self.args["seed"])
+        if self.model_epoch > 0:
+            with open(model_path(self.model_epoch), "rb") as f:
+                model.params = pickle.load(f)["params"]
+        return model
 
     # -- checkpointing ----------------------------------------------
     def update_model(self, model, steps):
@@ -375,165 +466,161 @@ class Learner:
 
     # -- episode / result intake ------------------------------------
     def feed_episodes(self, episodes):
-        for episode in episodes:
-            if episode is None:
-                continue
-            for p in episode["args"]["player"]:
-                model_id = episode["args"]["model_id"][p]
-                outcome = episode["outcome"][p]
-                n, r, r2 = self.generation_results.get(model_id, (0, 0, 0))
-                self.generation_results[model_id] = (
-                    n + 1, r + outcome, r2 + outcome ** 2)
-            self.num_returned_episodes += 1
-            if self.num_returned_episodes % 100 == 0:
-                print(self.num_returned_episodes, end=" ", flush=True)
-
-        self.trainer.episodes.extend(
-            [e for e in episodes if e is not None])
-
-        # RAM guard: shrink the buffer target under memory pressure
-        mem_percent = psutil.virtual_memory().percent if psutil else 0.0
-        mem_ok = mem_percent <= 95
-        maximum_episodes = (
-            self.args["maximum_episodes"] if mem_ok
-            else int(len(self.trainer.episodes) * 95 / mem_percent))
-        if not mem_ok and "memory_over" not in self.flags:
-            import warnings
-
-            warnings.warn(
-                "memory usage %.1f%% with buffer size %d"
-                % (mem_percent, len(self.trainer.episodes)))
-            self.flags.add("memory_over")
-        while len(self.trainer.episodes) > maximum_episodes:
-            self.trainer.episodes.popleft()
+        kept = [e for e in episodes if e is not None]
+        for episode in kept:
+            job = episode["args"]
+            for p in job["player"]:
+                stats = self.generation_stats.setdefault(
+                    job["model_id"][p], RunningScore())
+                stats.add(episode["outcome"][p])
+        before = self.episodes_received
+        self.episodes_received += len(kept)
+        for mark in range(before // 100 + 1,
+                          self.episodes_received // 100 + 1):
+            print(mark * 100, end=" ", flush=True)
+        self.replay.extend(kept)
 
     def feed_results(self, results):
         for result in results:
             if result is None:
                 continue
-            for p in result["args"]["player"]:
-                model_id = result["args"]["model_id"][p]
-                res = result["result"][p]
-                n, r, r2 = self.results.get(model_id, (0, 0, 0))
-                self.results[model_id] = n + 1, r + res, r2 + res ** 2
-                self.results_per_opponent.setdefault(model_id, {})
-                opponent = result["opponent"]
-                n, r, r2 = self.results_per_opponent[model_id].get(
-                    opponent, (0, 0, 0))
-                self.results_per_opponent[model_id][opponent] = (
-                    n + 1, r + res, r2 + res ** 2)
+            job, opponent = result["args"], result["opponent"]
+            for p in job["player"]:
+                model_id = job["model_id"][p]
+                score = result["result"][p]
+                self.eval_stats.setdefault(model_id, RunningScore()
+                                           ).add(score)
+                by_opp = self.eval_stats_by_opponent.setdefault(model_id, {})
+                by_opp.setdefault(opponent, RunningScore()).add(score)
 
     # -- epoch boundary ---------------------------------------------
+    def _report_win_rates(self, record):
+        """Print the epoch's eval summary (format is a public API: the
+        plot scripts parse these prefixes)."""
+        overall = self.eval_stats.get(self.model_epoch)
+        if overall is None:
+            print("win rate = Nan (0)")
+            return
+
+        def line(tag, score):
+            label = " (%s)" % tag if tag else ""
+            print("win rate%s = %.3f (%.1f / %d)"
+                  % (label, score.win_rate,
+                     (score.total + score.n) / 2, score.n))
+            record["win_rate" + ("_" + tag if tag else "")] = score.win_rate
+
+        by_opp = self.eval_stats_by_opponent.get(self.model_epoch, {})
+        single_opponent = (
+            len(self.args.get("eval", {}).get("opponent", [])) <= 1
+            and len(by_opp) <= 1)
+        if single_opponent:
+            line("", overall)
+        else:
+            line("total", overall)
+            for name in sorted(by_opp):
+                line(name, by_opp[name])
+
+    def _report_generation(self, record):
+        stats = self.generation_stats.get(self.model_epoch)
+        if stats is None:
+            print("generation stats = Nan (0)")
+            return
+        print("generation stats = %.3f +- %.3f" % (stats.mean, stats.std))
+        record["generation_mean"] = stats.mean
+        record["generation_std"] = stats.std
+
     def update(self):
         print()
         print("epoch %d" % self.model_epoch)
-        epoch_record = {"epoch": self.model_epoch}
-
-        if self.model_epoch not in self.results:
-            print("win rate = Nan (0)")
-        else:
-            def output_wp(name, results):
-                n, r, r2 = results
-                mean = r / (n + 1e-6)
-                name_tag = " (%s)" % name if name != "" else ""
-                print("win rate%s = %.3f (%.1f / %d)"
-                      % (name_tag, (mean + 1) / 2, (r + n) / 2, n))
-                epoch_record["win_rate" + ("_" + name if name else "")] = (
-                    (mean + 1) / 2)
-
-            keys = self.results_per_opponent[self.model_epoch]
-            if len(self.args.get("eval", {}).get("opponent", [])) <= 1 \
-                    and len(keys) <= 1:
-                output_wp("", self.results[self.model_epoch])
-            else:
-                output_wp("total", self.results[self.model_epoch])
-                for key in sorted(keys):
-                    output_wp(key, keys[key])
-
-        if self.model_epoch not in self.generation_results:
-            print("generation stats = Nan (0)")
-        else:
-            n, r, r2 = self.generation_results[self.model_epoch]
-            mean = r / (n + 1e-6)
-            std = (r2 / (n + 1e-6) - mean ** 2) ** 0.5
-            print("generation stats = %.3f +- %.3f" % (mean, std))
-            epoch_record["generation_mean"] = mean
-            epoch_record["generation_std"] = std
+        record = {"epoch": self.model_epoch}
+        self._report_win_rates(record)
+        self._report_generation(record)
 
         model, steps = self.trainer.update()
         if model is None:
             model = self.model
         self.update_model(model, steps)
-        epoch_record["steps"] = steps
-        epoch_record.update(getattr(self.trainer, "last_metrics", {}))
+        record["steps"] = steps
+        record.update(getattr(self.trainer, "last_metrics", {}))
         if self.metrics_path:
             with open(self.metrics_path, "a") as f:
-                f.write(json.dumps(epoch_record) + "\n")
-        self.flags = set()
+                f.write(json.dumps(record) + "\n")
+        self.replay.warned = False
 
     # -- server loop -------------------------------------------------
+    def _on_args(self, requests):
+        if self.shutdown_flag:
+            return [None for _ in requests]
+        return [self._assign_job() for _ in requests]
+
+    def _on_episode(self, episodes):
+        self.feed_episodes(episodes)
+        return [None for _ in episodes]
+
+    def _on_result(self, results):
+        self.feed_results(results)
+        return [None for _ in results]
+
+    def _on_model(self, model_ids):
+        return [self._serve_model(mid) for mid in model_ids]
+
     def server(self):
         print("started server")
-        prev_update_episodes = self.args["minimum_episodes"]
-        next_update_episodes = (
-            prev_update_episodes + self.args["update_episodes"])
+        handlers = {
+            "args": self._on_args,
+            "episode": self._on_episode,
+            "result": self._on_result,
+            "model": self._on_model,
+        }
+        next_epoch_at = (self.args["minimum_episodes"]
+                         + self.args["update_episodes"])
 
         while self.worker.connection_count() > 0 or not self.shutdown_flag:
             try:
-                conn, (req, data) = self.worker.recv(timeout=0.3)
+                conn, (verb, payload) = self.worker.recv(timeout=0.3)
             except queue.Empty:
                 continue
 
-            multi_req = isinstance(data, list)
-            if not multi_req:
-                data = [data]
-            send_data = []
+            # gathers batch requests into lists; single requests get a
+            # single reply back
+            batched = isinstance(payload, list)
+            handler = handlers.get(verb)
+            if handler is None:
+                # unknown verb from a stray/mis-versioned client: shrug
+                self.worker.send(conn, [] if batched else None)
+                continue
+            replies = handler(payload if batched else [payload])
+            self.worker.send(conn, replies if batched else replies[0])
 
-            if req == "args":
-                if self.shutdown_flag:
-                    send_data = [None] * len(data)
-                else:
-                    for _ in data:
-                        send_data.append(self._assign_job())
-            elif req == "episode":
-                self.feed_episodes(data)
-                send_data = [None] * len(data)
-            elif req == "result":
-                self.feed_results(data)
-                send_data = [None] * len(data)
-            elif req == "model":
-                for model_id in data:
-                    send_data.append(self._serve_model(model_id))
-
-            if not multi_req and len(send_data) == 1:
-                send_data = send_data[0]
-            self.worker.send(conn, send_data)
-
-            if self.num_returned_episodes >= next_update_episodes:
-                prev_update_episodes = next_update_episodes
-                next_update_episodes = (
-                    prev_update_episodes + self.args["update_episodes"])
+            if self.episodes_received >= next_epoch_at:
+                next_epoch_at += self.args["update_episodes"]
                 self.update()
                 if 0 <= self.args["epochs"] <= self.model_epoch:
                     self.shutdown_flag = True
         print("finished server")
 
     def _assign_job(self):
-        args = {"model_id": {}}
-        if self.num_results < self.eval_rate * self.num_episodes:
-            args["role"] = "e"
-            args["player"] = [
-                self.env.players()[
-                    self.num_results % len(self.env.players())]]
-            self.num_results += 1
+        """Split worker jobs between generation and evaluation so that
+        evaluation keeps pace at ``eval_rate`` of the episode stream."""
+        players = self.env.players()
+        wants_eval = self.jobs_evaluated < self.eval_rate * self.jobs_generated
+        if wants_eval:
+            seat = self.jobs_evaluated % len(players)
+            trained = [players[seat]]
+            self.jobs_evaluated += 1
+            role = "e"
         else:
-            args["role"] = "g"
-            args["player"] = self.env.players()
-            self.num_episodes += 1
-        for p in self.env.players():
-            args["model_id"][p] = (
-                self.model_epoch if p in args["player"] else -1)
-        return args
+            trained = list(players)
+            self.jobs_generated += 1
+            role = "g"
+        return {
+            "role": role,
+            "player": trained,
+            "model_id": {
+                p: self.model_epoch if p in trained else -1
+                for p in players
+            },
+        }
 
     def _serve_model(self, model_id):
         model = self.model
@@ -542,8 +629,8 @@ class Learner:
                 with open(model_path(model_id), "rb") as f:
                     state = pickle.load(f)
                 model = TPUModel(self.model.module, state["params"])
-            except OSError:
-                pass  # serve the latest model if the file is missing
+            except (OSError, pickle.UnpicklingError, EOFError):
+                pass  # missing/corrupt snapshot: serve the latest model
         return pickle.dumps(model)
 
     def run(self):

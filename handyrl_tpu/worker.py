@@ -1,23 +1,30 @@
 """Actor-side runtime: workers, gather fan-in, local & remote clusters.
 
-Role parity with /root/reference/handyrl/worker.py:26-271.  Workers are
-CPU processes running self-play (generation) or evaluation matches; a
-tree of Gather processes batches their requests so the learner serves
-O(num_gathers) connections instead of O(num_workers).  Remote machines
-join elastically through an entry handshake.
+Capability parity with the reference actor plane
+(/root/reference/handyrl/worker.py): CPU worker processes run
+self-play or evaluation jobs; a small tree of Gather processes batches
+their traffic so the learner serves O(gathers) connections instead of
+O(workers); remote machines join elastically through a one-shot entry
+handshake.
 
-TPU-native specifics: every child process pins its JAX to the CPU
-backend (``force_cpu_jax``) — actor inference is a CPU-jitted forward,
-the TPU belongs to the learner's update step alone.  Processes are
-spawned, not forked, because PJRT clients do not survive fork.
+The wire protocol is shared with the learner and is therefore fixed:
+request tuples ``(verb, payload)`` with verbs ``args`` / ``model`` /
+``episode`` / ``result`` (payload may be a list for batched requests),
+job-args dicts ``{role, player, model_id}``, and the two well-known
+ports below.  Everything else — model caching, job prefetch, upload
+batching — is organized framework-side here.
 
-Ports (same as the reference so operational docs carry over):
-  9999 — entry server: one-shot handshake assigning worker-id blocks
-  9998 — worker server: persistent gather connections
+TPU-native specifics: every child process pins JAX to the CPU backend
+(``force_cpu_jax``) — actor inference is a CPU-jitted forward; the TPU
+belongs to the learner's update step alone.  Processes are spawned,
+not forked, because PJRT clients do not survive fork.
+
+Ports (same numbers as the reference so operational docs carry over):
+  9999 — entry: one-shot handshake assigning worker-id blocks
+  9998 — worker: persistent gather connections
 """
 
 import copy
-import functools
 import pickle
 import queue
 import random
@@ -39,160 +46,185 @@ from .connection import (
 ENTRY_PORT = 9999
 WORKER_PORT = 9998
 
+_PEER_GONE = (ConnectionResetError, BrokenPipeError, EOFError, OSError)
+
+
+class ModelCache:
+    """Resolves model ids to actor-side models, fetching snapshots from
+    the learner on miss.
+
+    Id conventions (protocol): ``id < 0`` is an empty opponent slot,
+    ``id == 0`` is the uniform-random stand-in, positive ids are
+    learner epochs.  The newest epoch seen is kept warm since almost
+    every job asks for it.
+    """
+
+    def __init__(self, conn, env):
+        self._conn = conn
+        self._env = env
+        self._newest_id = -1
+        self._newest = None
+
+    def _fetch(self, model_id):
+        from .models import RandomModel
+
+        blob = send_recv(self._conn, ("model", model_id))
+        model = pickle.loads(blob)
+        if model_id == 0:
+            self._env.reset()
+            obs = self._env.observation(self._env.players()[0])
+            model = RandomModel(model, obs)
+        return model
+
+    def resolve(self, model_ids):
+        """Return {model_id: model} covering every id in the list."""
+        resolved = {}
+        for model_id in set(model_ids):
+            if model_id < 0:
+                resolved[model_id] = None
+            elif model_id == self._newest_id:
+                resolved[model_id] = self._newest
+            else:
+                model = self._fetch(model_id)
+                resolved[model_id] = model
+                if model_id > self._newest_id:
+                    self._newest_id, self._newest = model_id, model
+        return resolved
+
 
 class Worker:
-    """One actor process: request a job, fetch models, roll out, reply."""
+    """One actor process: pull a job, resolve its models, roll out an
+    episode or an evaluation match, push the result back."""
 
     def __init__(self, args, conn, wid):
         print(f"opened worker {wid}")
         self.worker_id = wid
         self.args = args
         self.conn = conn
-        self.latest_model = (-1, None)
+        random.seed(args["seed"] + wid)
 
         from .environment import make_env
         from .evaluation import Evaluator
         from .generation import Generator
 
         self.env = make_env({**args["env"], "id": wid})
-        self.generator = Generator(self.env, self.args)
-        self.evaluator = Evaluator(self.env, self.args)
-        random.seed(args["seed"] + wid)
+        self.models = ModelCache(conn, self.env)
+        generator = Generator(self.env, self.args)
+        evaluator = Evaluator(self.env, self.args)
+        # role -> (runner, reply verb): the job protocol's two roles
+        self.roles = {
+            "g": (generator.execute, "episode"),
+            "e": (evaluator.execute, "result"),
+        }
 
     def __del__(self):
         print(f"closed worker {self.worker_id}")
 
-    def _gather_models(self, model_ids):
-        from .models import RandomModel
-
-        model_pool = {}
-        for model_id in model_ids:
-            if model_id not in model_pool:
-                if model_id < 0:
-                    model_pool[model_id] = None
-                elif model_id == self.latest_model[0]:
-                    # the latest model is cached locally
-                    model_pool[model_id] = self.latest_model[1]
-                else:
-                    # request a snapshot from the learner
-                    model = pickle.loads(
-                        send_recv(self.conn, ("model", model_id)))
-                    if model_id == 0:
-                        # id 0 = uniform-random stand-in
-                        self.env.reset()
-                        obs = self.env.observation(self.env.players()[0])
-                        model = RandomModel(model, obs)
-                    model_pool[model_id] = model
-                    if model_id > self.latest_model[0]:
-                        self.latest_model = (model_id, model)
-        return model_pool
+    def _run_job(self, job):
+        id_by_player = job.get("model_id", {})
+        pool = self.models.resolve(list(id_by_player.values()))
+        models = {p: pool[mid] for p, mid in id_by_player.items()}
+        runner, reply_verb = self.roles[job["role"]]
+        send_recv(self.conn, (reply_verb, runner(models, job)))
 
     def run(self):
         try:
-            self._loop()
-        except (ConnectionResetError, BrokenPipeError, EOFError, OSError):
-            pass  # learner/gather is gone: exit quietly
-
-    def _loop(self):
-        while True:
-            args = send_recv(self.conn, ("args", None))
-            if args is None:
-                break
-            role = args["role"]
-
-            models = {}
-            if "model_id" in args:
-                model_ids = list(args["model_id"].values())
-                model_pool = self._gather_models(model_ids)
-                for p, model_id in args["model_id"].items():
-                    models[p] = model_pool[model_id]
-
-            if role == "g":
-                episode = self.generator.execute(models, args)
-                send_recv(self.conn, ("episode", episode))
-            elif role == "e":
-                result = self.evaluator.execute(models, args)
-                send_recv(self.conn, ("result", result))
+            while True:
+                job = send_recv(self.conn, ("args", None))
+                if job is None:
+                    return
+                self._run_job(job)
+        except _PEER_GONE:
+            pass  # learner/gather went away: exit quietly
 
 
-def make_worker_args(args, n_ga, gaid, base_wid, wid):
-    # interleaved worker ids across gathers (reference worker.py:90-91)
-    return args, base_wid + wid * n_ga + gaid
-
-
-def open_worker(conn, args, wid):
+def _spawn_worker(conn, args, wid):
     force_cpu_jax()
-    worker = Worker(args, conn, wid)
-    worker.run()
+    Worker(args, conn, wid).run()
 
 
 class Gather(QueueCommunicator):
-    """Fan-in proxy: one process per ~16 workers.
+    """Fan-in proxy between ~16 workers and the learner.
 
-    Prefetches job-arg blocks, caches model replies by id, and batches
-    episode/result uploads so learner round trips scale with gathers,
-    not workers (parity with /root/reference/handyrl/worker.py:99-173).
+    Three behaviors, one per verb class: job requests are served from a
+    prefetched block, model requests from an id-keyed cache, and
+    episode/result uploads are acked immediately and shipped upstream
+    in batches.  This keeps learner round-trips proportional to the
+    number of gathers (capability parity with the reference gather).
     """
+
+    CACHED_VERBS = ("model",)
 
     def __init__(self, args, conn, gather_id):
         print(f"started gather {gather_id}")
         self.gather_id = gather_id
-        self.server_conn = conn
-        self.args_queue = deque()
-        self.data_map = {"model": {}}
-        self.result_send_map = {}
-        self.result_send_cnt = 0
+        self.learner_conn = conn
+        self.job_queue = deque()
+        self.reply_cache = {verb: {} for verb in self.CACHED_VERBS}
+        self.pending_uploads = {}
+        self.pending_count = 0
 
-        n_pro = args["worker"]["num_parallel"]
-        n_ga = args["worker"]["num_gathers"]
-        num_workers = n_pro // n_ga + int(gather_id < n_pro % n_ga)
-        base_wid = args["worker"].get("base_worker_id", 0)
-
-        worker_conns = open_multiprocessing_connections(
-            num_workers,
-            open_worker,
-            functools.partial(make_worker_args, args, n_ga, gather_id,
-                              base_wid),
-        )
+        worker_conns = self._spawn_workers(args, gather_id)
         super().__init__(worker_conns)
-        self.buffer_length = 1 + len(worker_conns) // 4
+        self.block_size = 1 + len(worker_conns) // 4
+
+    @staticmethod
+    def _spawn_workers(args, gather_id):
+        wcfg = args["worker"]
+        n_total, n_gathers = wcfg["num_parallel"], wcfg["num_gathers"]
+        count = n_total // n_gathers + int(gather_id < n_total % n_gathers)
+        base = wcfg.get("base_worker_id", 0)
+
+        def worker_args(index):
+            # interleave ids across gathers so id blocks stay balanced
+            return args, base + index * n_gathers + gather_id
+
+        return open_multiprocessing_connections(
+            count, _spawn_worker, worker_args)
+
+    def _ask_learner(self, request):
+        self.learner_conn.send(request)
+        return self.learner_conn.recv()
+
+    def _serve_job(self, conn):
+        if not self.job_queue:
+            self.job_queue.extend(
+                self._ask_learner(("args", [None] * self.block_size)))
+        self.send(conn, self.job_queue.popleft())
+
+    def _serve_cached(self, conn, verb, key):
+        cache = self.reply_cache[verb]
+        if key not in cache:
+            cache[key] = self._ask_learner((verb, key))
+        self.send(conn, cache[key])
+
+    def _stage_upload(self, conn, verb, payload):
+        self.send(conn, None)  # ack now, ship later
+        self.pending_uploads.setdefault(verb, []).append(payload)
+        self.pending_count += 1
+        if self.pending_count >= self.block_size:
+            self.flush_uploads()
+
+    def flush_uploads(self):
+        for verb, payloads in self.pending_uploads.items():
+            self._ask_learner((verb, payloads))
+        self.pending_uploads = {}
+        self.pending_count = 0
 
     def run(self):
         while self.connection_count() > 0:
             try:
-                conn, (command, args) = self.recv(timeout=0.3)
+                conn, (verb, payload) = self.recv(timeout=0.3)
             except queue.Empty:
                 continue
-
-            if command == "args":
-                if not self.args_queue:
-                    # prefetch a block of job assignments
-                    self.server_conn.send(
-                        (command, [None] * self.buffer_length))
-                    self.args_queue.extend(self.server_conn.recv())
-                self.send(conn, self.args_queue.popleft())
-
-            elif command in self.data_map:
-                # cacheable request (model snapshots keyed by id)
-                if args not in self.data_map[command]:
-                    self.server_conn.send((command, args))
-                    self.data_map[command][args] = self.server_conn.recv()
-                self.send(conn, self.data_map[command][args])
-
+            if verb == "args":
+                self._serve_job(conn)
+            elif verb in self.reply_cache:
+                self._serve_cached(conn, verb, payload)
             else:
-                # ack first, batch the upload
-                self.send(conn, None)
-                self.result_send_map.setdefault(command, []).append(args)
-                self.result_send_cnt += 1
-                if self.result_send_cnt >= self.buffer_length:
-                    self._flush_results()
-
-    def _flush_results(self):
-        for command, args_list in self.result_send_map.items():
-            self.server_conn.send((command, args_list))
-            self.server_conn.recv()
-        self.result_send_map = {}
-        self.result_send_cnt = 0
+                self._stage_upload(conn, verb, payload)
+        if self.pending_count:
+            self.flush_uploads()  # don't drop episodes at shutdown
 
 
 def gather_loop(args, conn, gather_id):
@@ -200,113 +232,119 @@ def gather_loop(args, conn, gather_id):
     gather = Gather(args, conn, gather_id)
     try:
         gather.run()
-    except (ConnectionResetError, BrokenPipeError, EOFError, OSError):
-        pass  # learner is gone: exit quietly
+    except _PEER_GONE:
+        pass  # learner went away: exit quietly
+
+
+def _default_num_gathers(num_parallel):
+    return 1 + max(0, num_parallel - 1) // 16
 
 
 class WorkerCluster(QueueCommunicator):
-    """Local actor pool: gather processes over pipes."""
+    """Local actor pool: gather processes connected over pipes."""
 
     def __init__(self, args):
         super().__init__()
         self.args = args
 
     def run(self):
-        if "num_gathers" not in self.args["worker"]:
-            self.args["worker"]["num_gathers"] = (
-                1 + max(0, self.args["worker"]["num_parallel"] - 1) // 16)
-        for i in range(self.args["worker"]["num_gathers"]):
-            conn0, conn1 = _mp.Pipe(duplex=True)
+        wcfg = self.args["worker"]
+        wcfg.setdefault(
+            "num_gathers", _default_num_gathers(wcfg["num_parallel"]))
+        for gather_id in range(wcfg["num_gathers"]):
+            ours, theirs = _mp.Pipe(duplex=True)
             # gathers spawn worker children, so they cannot be daemonic;
             # they exit on their own once every worker disconnects
             _mp.Process(
-                target=gather_loop, args=(self.args, conn1, i)
+                target=gather_loop, args=(self.args, theirs, gather_id)
             ).start()
-            conn1.close()
-            self.add_connection(conn0)
+            theirs.close()
+            self.add_connection(ours)
 
 
 class WorkerServer(QueueCommunicator):
     """Learner-side acceptor for remote worker machines.
 
-    Two listener threads: the entry port hands out worker-id blocks and
-    the merged config; the worker port accepts persistent gather
-    connections into the communicator (elastic joins, parity with
-    /root/reference/handyrl/worker.py:192-224).
-    """
+    Two listener threads: the entry port hands out worker-id blocks
+    plus the merged config, and the worker port accepts persistent
+    gather connections into the communicator — so machines may join at
+    any time during training (elastic scale-out)."""
 
     def __init__(self, args):
         super().__init__()
         self.args = args
         self.total_worker_count = 0
 
-    def run(self):
-        threading.Thread(target=self._entry_server, daemon=True).start()
-        threading.Thread(target=self._worker_server, daemon=True).start()
+    def _admit(self, conn):
+        """Entry handshake: reserve an id block, reply merged config."""
+        remote_cfg = conn.recv()
+        print(f"accepted connection from {remote_cfg['address']}")
+        remote_cfg["base_worker_id"] = self.total_worker_count
+        self.total_worker_count += remote_cfg["num_parallel"]
+        merged = copy.deepcopy(self.args)
+        merged["worker"] = remote_cfg
+        conn.send(merged)
+        conn.close()
 
     def _entry_server(self):
         print(f"started entry server {ENTRY_PORT}")
         for conn in accept_socket_connections(port=ENTRY_PORT):
-            if conn is None:
-                continue
-            worker_args = conn.recv()
-            print(f"accepted connection from {worker_args['address']}")
-            worker_args["base_worker_id"] = self.total_worker_count
-            self.total_worker_count += worker_args["num_parallel"]
-            args = copy.deepcopy(self.args)
-            args["worker"] = worker_args
-            conn.send(args)
-            conn.close()
+            if conn is not None:
+                self._admit(conn)
 
     def _worker_server(self):
         print(f"started worker server {WORKER_PORT}")
         for conn in accept_socket_connections(port=WORKER_PORT):
-            if conn is None:
-                continue
-            self.add_connection(conn)
+            if conn is not None:
+                self.add_connection(conn)
+
+    def run(self):
+        threading.Thread(target=self._entry_server, daemon=True).start()
+        threading.Thread(target=self._worker_server, daemon=True).start()
 
 
 def entry(worker_args):
     """Remote machine -> learner handshake; returns the merged config."""
     conn = open_socket_connection(worker_args["server_address"], ENTRY_PORT)
     conn.send(worker_args)
-    args = conn.recv()
+    merged = conn.recv()
     conn.close()
-    return args
+    return merged
 
 
 class RemoteWorkerCluster:
-    """Worker-machine runtime: handshake, then gathers dialing the
-    learner's worker port."""
+    """Worker-machine runtime: handshake on the entry port, then local
+    gathers each dialing the learner's worker port."""
 
     def __init__(self, args):
         args["address"] = gethostname()
-        if "num_gathers" not in args:
-            args["num_gathers"] = 1 + max(0, args["num_parallel"] - 1) // 16
+        args.setdefault(
+            "num_gathers", _default_num_gathers(args["num_parallel"]))
         self.args = args
 
     def run(self):
-        args = entry(self.args)
-        print(args)
+        merged = entry(self.args)
+        print(merged)
         from .environment import prepare_env
 
-        prepare_env(args["env"])
-
-        process = []
+        prepare_env(merged["env"])
+        procs = []
         try:
-            for i in range(self.args["num_gathers"]):
+            for gather_id in range(self.args["num_gathers"]):
                 conn = open_socket_connection(
                     self.args["server_address"], WORKER_PORT)
-                p = _mp.Process(
-                    target=gather_loop, args=(args, conn, i))
-                p.start()
+                proc = _mp.Process(
+                    target=gather_loop, args=(merged, conn, gather_id))
+                proc.start()
                 conn.close()
-                process.append(p)
+                procs.append(proc)
             while True:
                 time.sleep(100)
         finally:
-            for p in process:
-                p.terminate()
+            # also reached on a partial launch failure: gathers are
+            # non-daemonic and must not be orphaned
+            for proc in procs:
+                proc.terminate()
 
 
 def worker_main(args, argv):
@@ -314,6 +352,4 @@ def worker_main(args, argv):
     if len(argv) >= 1:
         worker_args["num_parallel"] = int(argv[0])
         worker_args.pop("num_gathers", None)
-
-    worker = RemoteWorkerCluster(args=worker_args)
-    worker.run()
+    RemoteWorkerCluster(args=worker_args).run()
