@@ -1,70 +1,246 @@
-"""Benchmark: learner update steps/sec on the jitted training step.
+"""Benchmark: learner + actor throughput vs the measured reference.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-``vs_baseline`` compares against the reference's equivalent update loop
-measured on this host if available (see BASELINE.md: the reference
-publishes no numbers, so the ratio is against our recorded CPU-reference
-measurement when present, else 1.0).
+Prints ONE JSON line:
+  {"metric", "value", "unit", "vs_baseline", ...extras}
+
+Headline: jitted update-step throughput on GeeseNet at batch 256 with
+bf16 compute on device-resident batches — the production path (the
+Trainer's DevicePrefetcher stages batches in HBM so the step never
+waits on H2D).  ``vs_baseline`` is a REAL ratio against the reference
+implementation's own update loop measured on this host by
+scripts/measure_reference_baseline.py (BASELINE_MEASURED.json).
+Extras: float32 + batch-64 + host-transfer-bound numbers, actor
+env-frames/sec from a CPU subprocess (production actor config), and an
+achieved-FLOPs / MFU estimate from analytic conv FLOP counting.
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
 
+BATCH = 256
+SEED_EPS = 8
+R1_GEOMETRY_BATCH = 64
 
-def main():
-    from __graft_entry__ import _build_model_and_batch
+# bf16 peak TFLOP/s per chip by device kind (public specs); used only
+# for the MFU estimate.  Unknown kinds fall back to None -> mfu omitted.
+PEAK_TFLOPS = {
+    "TPU v4": 275.0,
+    "TPU v5": 459.0,
+    "TPU v5 lite": 197.0,
+    "TPU v5e": 197.0,
+    "TPU v6 lite": 918.0,
+    "TPU v6e": 918.0,
+}
+
+
+def _tile(batch, reps):
+    import jax
+    import numpy as np
+
+    return jax.tree.map(
+        lambda v: np.tile(v, (reps,) + (1,) * (v.ndim - 1)), batch)
+
+
+def model_flops_per_sample(params, board_cells=7 * 11):
+    """Analytic forward FLOPs per sample from the kernels:
+    2 * spatial * kh * kw * cin * cout per conv, 2 * din * dout dense."""
+    import jax
+
+    total = 0.0
+    for leaf in jax.tree.leaves(params):
+        shape = getattr(leaf, "shape", ())
+        if len(shape) == 4:  # NHWC conv kernel (kh, kw, cin, cout)
+            kh, kw, cin, cout = shape
+            total += 2.0 * board_cells * kh * kw * cin * cout
+        elif len(shape) == 2:  # dense (din, dout)
+            total += 2.0 * shape[0] * shape[1]
+    return total
+
+
+def measure_learner(seed, batch_size, compute_dtype, iters=30,
+                    host_iters=5, n_variants=4):
+    """Update-step steps/sec at ``batch_size``.
+
+    Returns (resident_sps, host_sps): device-resident batches (the
+    production path — batches staged in HBM by the prefetcher) and
+    host-numpy batches (every step pays the full H2D transfer).
+    Distinct batch permutations are cycled so constant data cannot
+    flatter caching.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
 
     from handyrl_tpu.ops.losses import LossConfig
     from handyrl_tpu.ops.update import make_optimizer, make_update_step
 
-    import numpy as np
+    model, seed_batch, cfg = seed
 
-    # generate a few real episodes, then tile to the benchmark batch
-    # size — rollout inference through the device tunnel is slow and is
-    # not what this benchmark measures (actors run on CPU in production)
-    batch_size = 64
-    seed_eps = 4
-    model, batch, cfg = _build_model_and_batch(
-        batch_size=seed_eps, env_name="HungryGeese")
-    import jax
+    rng = np.random.default_rng(0)
+    variants = []
+    for _ in range(n_variants):
+        perm = rng.permutation(SEED_EPS)
+        shuffled = jax.tree.map(lambda v: v[perm], seed_batch)
+        variants.append(_tile(shuffled, batch_size // SEED_EPS))
+    resident = [jax.device_put(v) for v in variants]
 
-    reps = batch_size // seed_eps
-    batch = jax.tree.map(
-        lambda v: np.tile(v, (reps,) + (1,) * (v.ndim - 1)), batch)
     loss_cfg = LossConfig.from_config(cfg)
     optimizer = make_optimizer(1e-3)
-    params = model.params
+    # fresh copies: the jitted step donates its inputs, and the seed
+    # model's params are reused across measurement runs
+    params = jax.tree.map(jnp.array, model.params)
     opt_state = optimizer.init(params)
-    update = make_update_step(model, loss_cfg, optimizer)
+    update = make_update_step(
+        model, loss_cfg, optimizer, compute_dtype=compute_dtype)
 
-    # compile + warmup
-    params, opt_state, metrics = update(params, opt_state, batch)
-    float(metrics["total"])
+    params, opt_state, metrics = update(params, opt_state, resident[0])
+    float(metrics["total"])  # compile + warmup sync
 
-    iters = 50
     t0 = time.perf_counter()
-    for _ in range(iters):
-        params, opt_state, metrics = update(params, opt_state, batch)
+    for i in range(iters):
+        params, opt_state, metrics = update(
+            params, opt_state, resident[i % n_variants])
     float(metrics["total"])  # sync
-    dt = time.perf_counter() - t0
+    resident_sps = iters / (time.perf_counter() - t0)
 
-    steps_per_sec = iters / dt
-    baseline = None
+    host_sps = None
+    if host_iters:
+        t0 = time.perf_counter()
+        for i in range(host_iters):
+            params, opt_state, metrics = update(
+                params, opt_state, variants[i % n_variants])
+        float(metrics["total"])  # sync
+        host_sps = host_iters / (time.perf_counter() - t0)
+    return resident_sps, host_sps
+
+
+def actor_child():
+    """CPU actor benchmark body (run in a subprocess with
+    JAX_PLATFORMS=cpu, like production workers)."""
+    import random
+
+    from handyrl_tpu.environment import make_env
+    from handyrl_tpu.generation import Generator
+    from handyrl_tpu.models import TPUModel
+
+    from __graft_entry__ import GEESE_CFG
+
+    random.seed(0)
+    env = make_env({"env": "HungryGeese"})
+    env.reset()
+    model = TPUModel(env.net())
+    model.init_params(env.observation(env.players()[0]), seed=0)
+    gen = Generator(env, dict(GEESE_CFG))
+    players = env.players()
+    job = {"player": players, "model_id": {p: 1 for p in players}}
+    models = {p: model for p in players}
+
+    # warmup (compile the CPU inference)
+    gen.generate(models, job)
+
+    episodes = 4
+    steps = 0
+    t0 = time.perf_counter()
+    done = 0
+    while done < episodes:
+        ep = gen.generate(models, job)
+        if ep is None:
+            continue
+        steps += ep["steps"]
+        done += 1
+    dt = time.perf_counter() - t0
+    n_players = len(players)
+    print(json.dumps({
+        "env_steps_per_sec": steps / dt,
+        "env_frames_per_sec": steps * n_players / dt,
+    }))
+
+
+def measure_actor():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--actor-child"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, cwd=os.path.dirname(os.path.abspath(__file__)),
+        timeout=1200,
+    )
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    return {}
+
+
+def main():
+    import jax
+
+    from __graft_entry__ import _build_model_and_batch
+
+    # real self-play seed episodes (uniform rollout policy), generated
+    # once and tiled/permuted per geometry
+    seed = _build_model_and_batch(
+        batch_size=SEED_EPS, env_name="HungryGeese")
+
+    sps_bf16, sps_bf16_host = measure_learner(seed, BATCH, "bfloat16")
+    sps_f32, _ = measure_learner(seed, BATCH, "float32", iters=20,
+                                 host_iters=0)
+    sps64_bf16, _ = measure_learner(seed, R1_GEOMETRY_BATCH, "bfloat16",
+                                    iters=20, host_iters=0)
+
+    baseline = {}
     try:
-        with open("BASELINE_MEASURED.json") as f:
-            baseline = json.load(f).get("learner_steps_per_sec")
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BASELINE_MEASURED.json")) as f:
+            baseline = json.load(f)
     except OSError:
         pass
-    vs = steps_per_sec / baseline if baseline else 1.0
+    ref256 = baseline.get(f"learner_steps_per_sec_b{BATCH}")
+    vs = sps_bf16 / ref256 if ref256 else 1.0
+
+    extras = {
+        "learner_steps_per_sec_b256_f32": round(sps_f32, 2),
+        "learner_steps_per_sec_b256_bf16_hostbatch": round(
+            sps_bf16_host, 2),
+        "learner_steps_per_sec_b64_bf16": round(sps64_bf16, 2),
+        "reference_steps_per_sec_b256_torch_cpu": ref256,
+        "reference_steps_per_sec_b64_torch_cpu":
+            baseline.get("learner_steps_per_sec"),
+    }
+
+    model, seed_batch, cfg = seed
+    samples = BATCH * cfg["forward_steps"] * 4  # B * T * P
+    # fwd + bwd ~= 3x forward FLOPs
+    flops_step = 3.0 * samples * model_flops_per_sample(model.params)
+    achieved = flops_step * sps_bf16 / 1e12
+    extras["flops_per_step_est"] = flops_step
+    extras["achieved_tflops_est"] = round(achieved, 2)
+    kind = jax.devices()[0].device_kind
+    extras["device_kind"] = kind
+    peak = PEAK_TFLOPS.get(kind)
+    if peak:
+        extras["mfu_est"] = round(achieved / peak, 4)
+
+    extras.update(measure_actor())
+    for key in ("env_frames_per_sec", "env_steps_per_sec"):
+        if key in extras:
+            extras[key] = round(extras[key], 1)
 
     print(json.dumps({
         "metric": "learner_update_steps_per_sec",
-        "value": round(steps_per_sec, 2),
-        "unit": (f"steps/sec (GeeseNet, "
-                 f"batch={batch_size}x{cfg['forward_steps']})"),
+        "value": round(sps_bf16, 2),
+        "unit": (f"steps/sec (GeeseNet bf16, device-resident "
+                 f"batch={BATCH}x{cfg['forward_steps']}x4p)"),
         "vs_baseline": round(vs, 3),
+        **extras,
     }))
 
 
 if __name__ == "__main__":
-    main()
+    if "--actor-child" in sys.argv:
+        actor_child()
+    else:
+        main()

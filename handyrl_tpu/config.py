@@ -77,6 +77,8 @@ class TrainConfig:
     compute_dtype: str = "float32"
     # structured metrics sink (jsonl path); "" disables
     metrics_path: str = ""
+    # XLA profiler trace output dir; "" disables trace capture
+    profile_dir: str = ""
 
     def __post_init__(self):
         if self.policy_target not in POLICY_TARGETS:

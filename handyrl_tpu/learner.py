@@ -45,6 +45,7 @@ from .batch import make_batch
 from .connection import MultiProcessJobExecutor
 from .environment import make_env, prepare_env
 from .models import TPUModel, snapshot_params
+from .utils.profiling import SectionTimers, TraceWindow
 from .ops.losses import LossConfig
 from .ops.update import (
     DEFAULT_LR,
@@ -151,6 +152,52 @@ class Batcher:
         self.executor.shutdown()
 
 
+class DevicePrefetcher:
+    """Stages upcoming batches in device memory from a background
+    thread, so H2D transfer overlaps the update step's compute and the
+    hot loop always finds a device-resident batch waiting."""
+
+    def __init__(self, source, depth, sharding=None):
+        self.source = source          # callable(timeout=) -> host batch
+        self.sharding = sharding      # None = default device
+        self.staged = queue.Queue(maxsize=max(1, depth))
+        self.stop_flag = False
+        self.error = None
+        self.thread = threading.Thread(target=self._pump, daemon=True)
+        self.thread.start()
+
+    def _pump(self):
+        try:
+            while not self.stop_flag:
+                try:
+                    batch = self.source(timeout=0.3)
+                except queue.Empty:
+                    continue
+                if self.sharding is not None:
+                    batch = jax.device_put(batch, self.sharding)
+                else:
+                    batch = jax.device_put(batch)
+                while not self.stop_flag:
+                    try:
+                        self.staged.put(batch, timeout=0.3)
+                        break
+                    except queue.Full:
+                        continue
+        except Exception as exc:  # surface in the trainer, don't hang it
+            self.error = exc
+
+    def get(self, timeout=None):
+        try:
+            return self.staged.get(timeout=timeout)
+        except queue.Empty:
+            if self.error is not None:
+                raise RuntimeError("device prefetch failed") from self.error
+            raise
+
+    def stop(self):
+        self.stop_flag = True
+
+
 class Trainer:
     """Owns device state (params + optimizer) and the jitted step."""
 
@@ -168,6 +215,10 @@ class Trainer:
         self.shutdown_flag = False
         self.update_queue = queue.Queue(maxsize=1)
         self.batcher = Batcher(self.args, self.episodes)
+        self.batch_sharding = None
+        self.prefetcher = None
+        self.timers = SectionTimers()
+        self.trace = TraceWindow(self.args.get("profile_dir") or "")
 
         if self.num_params > 0:
             self.optimizer = make_optimizer(
@@ -240,19 +291,28 @@ class Trainer:
         return {"dp": dp}
 
     def _build_update_step(self):
+        dtype = self.args.get("compute_dtype") or "float32"
         mesh_cfg = self.args.get("mesh") or {}
         if not mesh_cfg:
             # only auto-shard when the user left mesh unset; an explicit
             # all-ones mesh (e.g. {dp: 1}) forces the unsharded step
             mesh_cfg = self._default_mesh_cfg()
         if mesh_cfg and any(int(v) > 1 for v in mesh_cfg.values()):
-            from .parallel import MeshSpec, make_mesh, make_sharded_update_step
+            from .parallel import (
+                MeshSpec,
+                batch_sharding,
+                make_mesh,
+                make_sharded_update_step,
+            )
 
             mesh = make_mesh(MeshSpec.from_config(mesh_cfg))
+            self.batch_sharding = batch_sharding(mesh)
             return make_sharded_update_step(
-                self.model, self.loss_cfg, self.optimizer, mesh, self.params
+                self.model, self.loss_cfg, self.optimizer, mesh,
+                self.params, compute_dtype=dtype,
             )
-        return make_update_step(self.model, self.loss_cfg, self.optimizer)
+        return make_update_step(
+            self.model, self.loss_cfg, self.optimizer, compute_dtype=dtype)
 
     def update(self):
         """Called by the Learner: finish the epoch, get a snapshot."""
@@ -272,11 +332,14 @@ class Trainer:
             if self.shutdown_flag:
                 return None
             try:
-                batch = self.batcher.batch(timeout=0.3)
+                with self.timers.section("batch_wait"):
+                    batch = self.prefetcher.get(timeout=0.3)
             except queue.Empty:
                 continue
-            self.params, self.opt_state, metrics = self.update_step(
-                self.params, self.opt_state, batch)
+            with self.timers.section("update"):
+                self.params, self.opt_state, metrics = self.update_step(
+                    self.params, self.opt_state, batch)
+            self.trace.tick()
             # keep metrics on device; sync once per epoch
             metric_acc.append(metrics)
             batch_cnt += 1
@@ -291,6 +354,10 @@ class Trainer:
 
         print("loss = %s" % " ".join(
             [k + ":" + "%.3f" % (l / data_cnt) for k, l in loss_sum.items()]))
+        prof = self.timers.snapshot()
+        if prof:
+            # batch_wait = feed starvation; update = device dispatch+step
+            print("profile = %s" % self.timers.format(prof))
 
         self.data_cnt_ema = (
             self.data_cnt_ema * 0.8 + data_cnt / (1e-2 + batch_cnt) * 0.2)
@@ -302,6 +369,8 @@ class Trainer:
         snapshot = TPUModel(self.model.module)
         snapshot.params = jax.tree.map(np.asarray, self.params)
         self.last_metrics = {k: l / data_cnt for k, l in loss_sum.items()}
+        for name, v in prof.items():
+            self.last_metrics[f"profile_{name}_sec"] = v["sec"]
         self.epoch += 1
         try:
             os.makedirs(_models_dir(), exist_ok=True)
@@ -313,6 +382,9 @@ class Trainer:
     def shutdown(self):
         """Stop the training thread (checked between batches)."""
         self.shutdown_flag = True
+        self.trace.close()
+        if self.prefetcher is not None:
+            self.prefetcher.stop()
         self.batcher.shutdown()
 
     def run(self):
@@ -323,6 +395,11 @@ class Trainer:
             time.sleep(1)
         if self.optimizer is not None:
             self.batcher.run()
+            self.prefetcher = DevicePrefetcher(
+                self.batcher.batch,
+                depth=self.args.get("prefetch_batches", 2),
+                sharding=self.batch_sharding,
+            )
             print("started training")
         while not self.shutdown_flag:
             model = self.train()

@@ -49,12 +49,47 @@ def set_learning_rate(opt_state, lr: float):
     return opt_state
 
 
-def make_update_step(model, cfg: LossConfig,
-                     optimizer: optax.GradientTransformation) -> Callable:
-    """Build the jitted ``update_step`` for a TPUModel + config."""
+def _cast_floats(tree, dtype):
+    return jax.tree.map(
+        lambda a: a.astype(dtype)
+        if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a,
+        tree,
+    )
+
+
+def make_apply_fn(model, compute_dtype="float32") -> Callable:
+    """The net's forward for the update step.
+
+    With a low-precision ``compute_dtype`` (bfloat16 on TPU), master
+    params stay float32 and only the forward runs low-precision: params,
+    observations, and hidden are cast on the way in, head outputs back
+    to float32 on the way out — so the matmuls/convs hit the MXU at
+    bf16 while the loss math and Adam state keep full precision.
+    """
+    dtype = jnp.dtype(compute_dtype)
+    if dtype == jnp.float32:
+        def apply_fn(params, obs, hidden):
+            return model.module.apply({"params": params}, obs, hidden)
+        return apply_fn
 
     def apply_fn(params, obs, hidden):
-        return model.module.apply({"params": params}, obs, hidden)
+        out = model.module.apply(
+            {"params": _cast_floats(params, dtype)},
+            _cast_floats(obs, dtype),
+            _cast_floats(hidden, dtype),
+        )
+        return _cast_floats(out, jnp.float32)
+
+    return apply_fn
+
+
+def make_update_core(model, cfg: LossConfig,
+                     optimizer: optax.GradientTransformation,
+                     compute_dtype: str = "float32") -> Callable:
+    """The un-jitted ``update_step(params, opt_state, batch)`` body —
+    shared by the single-device jit below and the sharded wrapper in
+    :mod:`handyrl_tpu.parallel.update`."""
+    apply_fn = make_apply_fn(model, compute_dtype)
 
     def loss_fn(params, batch, hidden):
         losses, dcnt = compute_loss(apply_fn, params, batch, hidden, cfg)
@@ -73,4 +108,14 @@ def make_update_step(model, cfg: LossConfig,
                    "grad_norm": optax.global_norm(grads)}
         return params, opt_state, metrics
 
-    return jax.jit(update_step, donate_argnums=(0, 1))
+    return update_step
+
+
+def make_update_step(model, cfg: LossConfig,
+                     optimizer: optax.GradientTransformation,
+                     compute_dtype: str = "float32") -> Callable:
+    """Build the jitted ``update_step`` for a TPUModel + config."""
+    return jax.jit(
+        make_update_core(model, cfg, optimizer, compute_dtype),
+        donate_argnums=(0, 1),
+    )

@@ -1,10 +1,11 @@
 """Sharded learner update step.
 
-Wraps :func:`handyrl_tpu.ops.update.make_update_step`'s body in a jit
-with explicit in/out shardings over a device mesh: batch on ``dp``
-(+ optionally time on ``sp``), params/optimizer state per the tp rules.
-Gradient reduction across ``dp`` becomes an XLA all-reduce over ICI —
-the TPU-native replacement for the reference's ``nn.DataParallel``
+Wraps the shared update-step body from
+:func:`handyrl_tpu.ops.update.make_update_core` in a jit with explicit
+in/out shardings over a device mesh: batch on ``dp`` (+ optionally time
+on ``sp``), params/optimizer state per the tp rules.  Gradient
+reduction across ``dp`` becomes an XLA all-reduce over ICI — the
+TPU-native replacement for the reference's ``nn.DataParallel``
 scatter/gather (/root/reference/handyrl/train.py:340-341).
 """
 
@@ -13,59 +14,42 @@ from typing import Callable
 import jax
 import optax
 
-from ..ops.losses import LossConfig, compute_loss
+from ..ops.losses import LossConfig
+from ..ops.update import make_update_core
 from .mesh import batch_sharding, param_sharding, replicated
+
+
+def opt_state_sharding(optimizer, params, p_shard, rep):
+    """Shardings for the optimizer state, derived structurally: leaves
+    that occupy param positions (Adam moments) inherit the matching
+    param's sharding; everything else (counts, hyperparams) replicates.
+    """
+    opt_shape = jax.eval_shape(optimizer.init, params)
+    return optax.tree_map_params(
+        optimizer,
+        lambda _, shard: shard,
+        opt_shape,
+        p_shard,
+        transform_non_params=lambda _: rep,
+    )
 
 
 def make_sharded_update_step(model, cfg: LossConfig,
                              optimizer: optax.GradientTransformation,
                              mesh, params,
-                             shard_time: bool = False) -> Callable:
+                             shard_time: bool = False,
+                             compute_dtype: str = "float32") -> Callable:
     """Build the jitted SPMD ``update_step`` for a mesh.
 
     ``params`` is only inspected for its pytree structure/shapes to
     compute shardings; pass the live params at call time as usual.
     """
-
-    def apply_fn(p, obs, hidden):
-        return model.module.apply({"params": p}, obs, hidden)
-
-    def loss_fn(p, batch, hidden):
-        losses, dcnt = compute_loss(apply_fn, p, batch, hidden, cfg)
-        return losses["total"], (losses, dcnt)
-
-    def update_step(params, opt_state, batch):
-        B = batch["value"].shape[0]
-        P = batch["value"].shape[2]
-        hidden = model.init_hidden([B, P])
-        grads, (losses, dcnt) = jax.grad(loss_fn, has_aux=True)(
-            params, batch, hidden
-        )
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        metrics = {**losses, "dcnt": dcnt,
-                   "grad_norm": optax.global_norm(grads)}
-        return params, opt_state, metrics
+    update_step = make_update_core(model, cfg, optimizer, compute_dtype)
 
     p_shard = param_sharding(mesh, params)
     b_shard = batch_sharding(mesh, time_axis=1 if shard_time else None)
     rep = replicated(mesh)
-
-    # optimizer state mirrors param sharding where leaves match params'
-    # structure (Adam moments); scalars/hyperparams replicate.
-    opt_state0 = jax.eval_shape(optimizer.init, params)
-    param_leaves = {
-        id_shape: s
-        for id_shape, s in zip(
-            [l.shape for l in jax.tree.leaves(params)],
-            jax.tree.leaves(p_shard),
-        )
-    }
-
-    def opt_spec(leaf):
-        return param_leaves.get(getattr(leaf, "shape", None), rep)
-
-    o_shard = jax.tree.map(opt_spec, opt_state0)
+    o_shard = opt_state_sharding(optimizer, params, p_shard, rep)
 
     return jax.jit(
         update_step,

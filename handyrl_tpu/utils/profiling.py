@@ -1,0 +1,90 @@
+"""Lightweight profiling: per-section wall timers + XLA trace capture.
+
+The reference has no profiling at all (SURVEY §5); here observability
+is first-class:
+
+  * ``SectionTimers`` — near-zero-cost named wall-clock sections for
+    the learner hot loop (batch wait vs device step), reported per
+    epoch and fed into the metrics jsonl;
+  * ``TraceWindow`` — captures a ``jax.profiler`` trace of a span of
+    update steps into ``profile_dir`` (viewable in TensorBoard /
+    Perfetto), armed by the ``profile_dir`` config key.
+"""
+
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+
+import jax
+
+
+class SectionTimers:
+    """Accumulate wall time per named section between snapshots."""
+
+    def __init__(self):
+        self.totals = defaultdict(float)
+        self.counts = defaultdict(int)
+
+    @contextmanager
+    def section(self, name):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.totals[name] += time.perf_counter() - t0
+            self.counts[name] += 1
+
+    def snapshot(self, reset=True):
+        """{name: {"sec": total, "n": count}}, optionally resetting."""
+        out = {
+            name: {"sec": round(self.totals[name], 4),
+                   "n": self.counts[name]}
+            for name in self.totals
+        }
+        if reset:
+            self.totals.clear()
+            self.counts.clear()
+        return out
+
+    def format(self, snap=None):
+        snap = self.snapshot() if snap is None else snap
+        return " ".join(
+            f"{name}:{v['sec']:.2f}s/{v['n']}"
+            for name, v in sorted(snap.items())
+        )
+
+
+class TraceWindow:
+    """Capture one XLA/TPU profiler trace over a window of steps.
+
+    ``tick()`` once per update step: the trace starts at
+    ``start_step`` and stops at ``stop_step`` (after compilation noise
+    has settled).  Inactive when ``trace_dir`` is empty.
+    """
+
+    def __init__(self, trace_dir, start_step=10, stop_step=20):
+        self.trace_dir = trace_dir
+        self.start_step = start_step
+        self.stop_step = stop_step
+        self.step = 0
+        self.active = False
+        self.done = not trace_dir
+
+    def tick(self):
+        if self.done:
+            return
+        self.step += 1
+        if not self.active and self.step >= self.start_step:
+            jax.profiler.start_trace(self.trace_dir)
+            self.active = True
+        elif self.active and self.step >= self.stop_step:
+            jax.profiler.stop_trace()
+            self.active = False
+            self.done = True
+            print(f"profiler trace written to {self.trace_dir}")
+
+    def close(self):
+        if self.active:
+            jax.profiler.stop_trace()
+            self.active = False
+            self.done = True

@@ -1,0 +1,131 @@
+"""Measure the reference implementation's learner throughput on THIS host.
+
+Drives the reference's own update loop — compute_loss / backward /
+clip_grad_norm(4.0) / Adam.step, i.e. /root/reference/handyrl/train.py
+Trainer.train semantics — by importing the reference package from
+/root/reference (no code is copied) and feeding it synthetic batches in
+its native (B, T, P, ...) tensor format at the same GeeseNet geometry
+our bench uses.  Results land in BASELINE_MEASURED.json, which bench.py
+reads to report a real ``vs_baseline`` ratio.
+
+The reference is torch-CPU on this host (it has no TPU path); this is
+the honest like-for-like "reference on the same machine" number the
+driver asked for.  Run:
+
+    PYTHONPATH=/root/repo python scripts/measure_reference_baseline.py
+"""
+
+import json
+import os
+import sys
+import time
+
+REFERENCE_ROOT = "/root/reference"
+
+GEESE_ARGS = {
+    "turn_based_training": False,
+    "observation": False,
+    "gamma": 0.8,
+    "forward_steps": 8,
+    "burn_in_steps": 0,
+    "compress_steps": 4,
+    "entropy_regularization": 0.1,
+    "entropy_regularization_decay": 0.1,
+    "lambda": 0.7,
+    "policy_target": "UPGO",
+    "value_target": "TD",
+}
+
+OBS_SHAPE = (17, 7, 11)  # reference GeeseNet input planes
+NUM_ACTIONS = 4
+NUM_PLAYERS = 4
+
+
+def synthetic_batch(torch, batch_size, steps):
+    """A batch in the reference make_batch output format
+    (train.py:33-125): simultaneous 4-player play, all seats active."""
+    g = torch.Generator().manual_seed(0)
+    B, T, P = batch_size, steps, NUM_PLAYERS
+    obs = torch.rand((B, T, P) + OBS_SHAPE, generator=g)
+    ones = torch.ones((B, T, P, 1))
+    return {
+        "observation": obs,
+        "selected_prob": torch.full((B, T, P, 1), 0.25),
+        "value": torch.zeros((B, T, P, 1)),
+        "action": torch.randint(0, NUM_ACTIONS, (B, T, P, 1), generator=g),
+        "outcome": (torch.randint(0, 2, (B, 1, P, 1), generator=g)
+                    .float() * 2 - 1),
+        "reward": torch.zeros((B, T, P, 1)),
+        "return": torch.zeros((B, T, P, 1)),
+        "episode_mask": torch.ones((B, T, 1, 1)),
+        "turn_mask": ones.clone(),
+        "observation_mask": ones.clone(),
+        "action_mask": torch.zeros((B, T, P, NUM_ACTIONS)),
+        "progress": (torch.arange(T).float() / T)
+        .reshape(1, T, 1).repeat(B, 1, 1),
+    }
+
+
+def measure(batch_size, steps, iters, warmup=1):
+    sys.path.insert(0, REFERENCE_ROOT)
+    import torch
+    torch.set_num_threads(os.cpu_count() or 1)
+
+    # the reference env module imports kaggle_environments at load time;
+    # we only need its GeeseNet class, so satisfy the import with a stub
+    import types
+
+    if "kaggle_environments" not in sys.modules:
+        stub = types.ModuleType("kaggle_environments")
+        stub.make = lambda *a, **k: None
+        sys.modules["kaggle_environments"] = stub
+
+    from handyrl.envs.kaggle.hungry_geese import GeeseNet
+    from handyrl.train import compute_loss
+
+    model = GeeseNet()
+    model.train()
+    optimizer = torch.optim.Adam(
+        model.parameters(), lr=3e-8 * batch_size * steps,
+        weight_decay=1e-5)
+    batch = synthetic_batch(torch, batch_size, steps)
+    args = dict(GEESE_ARGS, forward_steps=steps)
+
+    def one_step():
+        # the reference hot loop: train.py:358-372
+        losses, dcnt = compute_loss(batch, model, None, args)
+        optimizer.zero_grad()
+        losses["total"].backward()
+        torch.nn.utils.clip_grad_norm_(model.parameters(), 4.0)
+        optimizer.step()
+
+    for _ in range(warmup):
+        one_step()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        one_step()
+    dt = time.perf_counter() - t0
+    return iters / dt
+
+
+def main():
+    results = {
+        "source": "reference handyrl (torch CPU) update loop on this host",
+        "model": "GeeseNet",
+        "host_cpu_count": os.cpu_count(),
+    }
+    for batch_size, iters in ((64, 6), (256, 3)):
+        sps = measure(batch_size, steps=8, iters=iters)
+        key = ("learner_steps_per_sec" if batch_size == 64
+               else f"learner_steps_per_sec_b{batch_size}")
+        results[key] = round(sps, 4)
+        print(f"batch {batch_size}: {sps:.4f} steps/s")
+    out = os.path.join(os.path.dirname(__file__), "..",
+                       "BASELINE_MEASURED.json")
+    with open(out, "w") as f:
+        json.dump(results, f, indent=1)
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()
