@@ -164,10 +164,15 @@ def measure_actor():
     env["JAX_PLATFORMS"] = "cpu"
     proc = subprocess.run(
         [sys.executable, os.path.abspath(__file__), "--actor-child"],
-        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         text=True, cwd=os.path.dirname(os.path.abspath(__file__)),
         timeout=1200,
     )
+    if proc.returncode != 0:
+        tail = "\n".join(proc.stderr.splitlines()[-5:])
+        print(f"actor bench child failed (rc={proc.returncode}): {tail}",
+              file=sys.stderr)
+        return {"actor_bench_error": proc.returncode}
     for line in reversed(proc.stdout.splitlines()):
         line = line.strip()
         if line.startswith("{"):

@@ -196,6 +196,8 @@ class DevicePrefetcher:
 
     def stop(self):
         self.stop_flag = True
+        # don't let interpreter teardown race an in-flight device_put
+        self.thread.join(timeout=5)
 
 
 class Trainer:
@@ -213,6 +215,7 @@ class Trainer:
         self.steps = 0
         self.update_flag = False
         self.shutdown_flag = False
+        self.failure = None
         self.update_queue = queue.Queue(maxsize=1)
         self.batcher = Batcher(self.args, self.episodes)
         self.batch_sharding = None
@@ -305,20 +308,29 @@ class Trainer:
                 make_sharded_update_step,
             )
 
-            mesh = make_mesh(MeshSpec.from_config(mesh_cfg))
+            spec = MeshSpec.from_config(mesh_cfg)
+            mesh = make_mesh(spec)
             self.batch_sharding = batch_sharding(mesh)
             return make_sharded_update_step(
                 self.model, self.loss_cfg, self.optimizer, mesh,
-                self.params, compute_dtype=dtype,
+                self.params, shard_time=spec.sp > 1, compute_dtype=dtype,
             )
         return make_update_step(
             self.model, self.loss_cfg, self.optimizer, compute_dtype=dtype)
 
     def update(self):
-        """Called by the Learner: finish the epoch, get a snapshot."""
+        """Called by the Learner: finish the epoch, get a snapshot.
+
+        Returns ``(None, steps)`` if the training thread has died —
+        the learner then keeps serving the last model instead of
+        blocking forever on a queue no one will fill."""
         self.update_flag = True
-        model, steps = self.update_queue.get()
-        return model, steps
+        while True:
+            try:
+                return self.update_queue.get(timeout=1)
+            except queue.Empty:
+                if self.failure is not None or self.shutdown_flag:
+                    return None, self.steps
 
     def train(self):
         if self.optimizer is None:  # non-parametric model
@@ -401,17 +413,26 @@ class Trainer:
                 sharding=self.batch_sharding,
             )
             print("started training")
-        while not self.shutdown_flag:
-            model = self.train()
-            if model is None:
-                break
-            self.update_flag = False
+        try:
             while not self.shutdown_flag:
-                try:
-                    self.update_queue.put((model, self.steps), timeout=0.3)
+                model = self.train()
+                if model is None:
                     break
-                except queue.Full:
-                    continue
+                self.update_flag = False
+                while not self.shutdown_flag:
+                    try:
+                        self.update_queue.put(
+                            (model, self.steps), timeout=0.3)
+                        break
+                    except queue.Full:
+                        continue
+        except Exception as exc:
+            # record before dying so Learner.update() can't deadlock
+            # waiting on a snapshot this thread will never produce
+            import traceback
+
+            traceback.print_exc()
+            self.failure = exc
 
 
 class RunningScore:

@@ -12,6 +12,7 @@ scatter/gather (/root/reference/handyrl/train.py:340-341).
 from typing import Callable
 
 import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
 import optax
 
 from ..ops.losses import LossConfig
@@ -44,10 +45,30 @@ def make_sharded_update_step(model, cfg: LossConfig,
     ``params`` is only inspected for its pytree structure/shapes to
     compute shardings; pass the live params at call time as usual.
     """
-    update_step = make_update_core(model, cfg, optimizer, compute_dtype)
+    core = make_update_core(model, cfg, optimizer, compute_dtype)
+
+    sp_size = mesh.shape["sp"]
+    if shard_time and sp_size > 1:
+        # sequence parallelism: lay the time axis over ``sp`` too.  The
+        # constraint is applied per-leaf inside the jit (shapes are
+        # known at trace time) because not every batch channel carries
+        # a full time axis — e.g. ``outcome`` is (B, 1, P, 1).
+        time_sharded = NamedSharding(mesh, P("dp", "sp"))
+
+        def stage_time(leaf):
+            if (leaf.ndim >= 2 and leaf.shape[1] > 1
+                    and leaf.shape[1] % sp_size == 0):
+                return jax.lax.with_sharding_constraint(leaf, time_sharded)
+            return leaf
+
+        def update_step(params, opt_state, batch):
+            return core(params, opt_state,
+                        jax.tree.map(stage_time, batch))
+    else:
+        update_step = core
 
     p_shard = param_sharding(mesh, params)
-    b_shard = batch_sharding(mesh, time_axis=1 if shard_time else None)
+    b_shard = batch_sharding(mesh)
     rep = replicated(mesh)
     o_shard = opt_state_sharding(optimizer, params, p_shard, rep)
 

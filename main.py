@@ -9,12 +9,26 @@ Same command surface as the reference (/root/reference/main.py:19-36):
   --eval-client / -ec    network battle client
 """
 
+import os
 import sys
 
 import yaml
 
 
+def _honor_platform_env():
+    """An explicit JAX_PLATFORMS env var wins over any platform a host
+    sitecustomize pre-pinned (e.g. running the learner on a virtual
+    CPU device mesh: JAX_PLATFORMS=cpu
+    XLA_FLAGS=--xla_force_host_platform_device_count=8)."""
+    requested = os.environ.get("JAX_PLATFORMS")
+    if requested:
+        import jax
+
+        jax.config.update("jax_platforms", requested)
+
+
 def main():
+    _honor_platform_env()
     with open("config.yaml") as f:
         args = yaml.safe_load(f)
     print(args)
