@@ -73,8 +73,10 @@ class TrainConfig:
     mesh: Dict[str, int] = field(default_factory=dict)
     # number of device-resident batches to keep prefetched
     prefetch_batches: int = 2
-    # parameter/compute dtype for the update step
-    compute_dtype: str = "float32"
+    # compute dtype for the update step: bfloat16 rides the MXU at
+    # full rate (params/optimizer stay float32); set "float32" to
+    # opt out for numerics debugging
+    compute_dtype: str = "bfloat16"
     # structured metrics sink (jsonl path); "" disables
     metrics_path: str = ""
     # XLA profiler trace output dir; "" disables trace capture

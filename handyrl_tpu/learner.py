@@ -251,17 +251,22 @@ class Trainer:
                   % (state.get("epoch"), restart_epoch))
             return
         try:
-            self.opt_state = jax.tree.map(
+            # read everything into temporaries first so a mismatch on a
+            # later key cannot leave a half-restored optimizer behind
+            opt_state = jax.tree.map(
                 lambda like, saved: jax.numpy.asarray(saved),
                 self.opt_state, state["opt_state"])
-            self.steps = state["steps"]
-            self.data_cnt_ema = state["data_cnt_ema"]
+            steps = state["steps"]
+            data_cnt_ema = state["data_cnt_ema"]
         except (ValueError, TypeError, KeyError):
             # pytree structure changed (e.g. the net was modified
             # between runs): cold-start rather than crash at startup
             print("train state does not match the current model: "
                   "cold-starting the optimizer")
             return
+        self.opt_state = opt_state
+        self.steps = steps
+        self.data_cnt_ema = data_cnt_ema
         print(f"restored optimizer state at step {self.steps}")
 
     def save_train_state(self, epoch):
@@ -283,18 +288,24 @@ class Trainer:
         n_dev = jax.device_count()
         if n_dev <= 1:
             return {}
-        import math
-
-        dp = math.gcd(self.args["batch_size"], n_dev)
+        batch = self.args["batch_size"]
+        # largest divisor of the batch that fits the host, so an odd
+        # batch size degrades gracefully instead of to gcd-of-2
+        dp = max(d for d in range(1, n_dev + 1) if batch % d == 0)
         if dp <= 1:
             print(f"1 of {n_dev} devices used: batch_size "
-                  f"{self.args['batch_size']} has no common factor")
+                  f"{batch} has no divisor <= {n_dev}")
             return {}
+        if dp < n_dev:
+            print(f"WARNING: dp={dp} leaves {n_dev - dp} of {n_dev} "
+                  f"devices idle; make batch_size divisible by {n_dev} "
+                  f"or set an explicit mesh")
         print(f"defaulting to dp={dp} over {n_dev} devices")
         return {"dp": dp}
 
     def _build_update_step(self):
-        dtype = self.args.get("compute_dtype") or "float32"
+        dtype = self.args.get("compute_dtype") or "bfloat16"
+        print(f"compute dtype: {dtype}")
         mesh_cfg = self.args.get("mesh") or {}
         if not mesh_cfg:
             # only auto-shard when the user left mesh unset; an explicit
@@ -392,9 +403,12 @@ class Trainer:
         return snapshot
 
     def shutdown(self):
-        """Stop the training thread (checked between batches)."""
+        """Stop the training thread (checked between batches).
+
+        The profiler trace is NOT closed here: ``trace`` belongs to the
+        training thread (tick() runs there), so close() happens in
+        ``run``'s finally block to avoid racing a tick mid-start."""
         self.shutdown_flag = True
-        self.trace.close()
         if self.prefetcher is not None:
             self.prefetcher.stop()
         self.batcher.shutdown()
@@ -433,6 +447,8 @@ class Trainer:
 
             traceback.print_exc()
             self.failure = exc
+        finally:
+            self.trace.close()  # this thread owns the profiler trace
 
 
 class RunningScore:

@@ -30,7 +30,7 @@ import queue
 import random
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from socket import gethostname
 
 from .connection import (
@@ -55,15 +55,36 @@ class ModelCache:
 
     Id conventions (protocol): ``id < 0`` is an empty opponent slot,
     ``id == 0`` is the uniform-random stand-in, positive ids are
-    learner epochs.  The newest epoch seen is kept warm since almost
-    every job asks for it.
+    learner epochs.  A small LRU keeps the newest epoch plus recent
+    old-epoch opponents (league/past-self play) warm, and when a new
+    epoch arrives with the same net structure the previous instance is
+    re-pointed at the new params — preserving its compiled inference
+    function across epochs instead of re-jitting every 200 episodes.
     """
+
+    CAPACITY = 3  # newest epoch + a couple of league opponents
 
     def __init__(self, conn, env):
         self._conn = conn
         self._env = env
+        self._cache = OrderedDict()  # model_id -> model (LRU order)
         self._newest_id = -1
-        self._newest = None
+
+    def _adopt(self, model):
+        """Warm the new epoch's model with the previous newest
+        instance's compiled inference function.  Params are passed as
+        jit *arguments*, so the trace is weight-independent; the cached
+        instance itself is left untouched (it may still serve its own
+        epoch in the same resolve call)."""
+        prev = self._cache.get(self._newest_id)
+        if prev is None or not hasattr(prev, "module"):
+            return model
+        try:
+            if prev.module == model.module:
+                model._jitted = prev._jitted
+        except Exception:
+            pass
+        return model
 
     def _fetch(self, model_id):
         from .models import RandomModel
@@ -74,6 +95,8 @@ class ModelCache:
             self._env.reset()
             obs = self._env.observation(self._env.players()[0])
             model = RandomModel(model, obs)
+        elif model_id > self._newest_id:
+            model = self._adopt(model)
         return model
 
     def resolve(self, model_ids):
@@ -82,13 +105,17 @@ class ModelCache:
         for model_id in set(model_ids):
             if model_id < 0:
                 resolved[model_id] = None
-            elif model_id == self._newest_id:
-                resolved[model_id] = self._newest
-            else:
-                model = self._fetch(model_id)
-                resolved[model_id] = model
-                if model_id > self._newest_id:
-                    self._newest_id, self._newest = model_id, model
+                continue
+            if model_id in self._cache:
+                self._cache.move_to_end(model_id)
+                resolved[model_id] = self._cache[model_id]
+                continue
+            model = self._fetch(model_id)
+            self._cache[model_id] = model
+            self._newest_id = max(self._newest_id, model_id)
+            while len(self._cache) > self.CAPACITY:
+                self._cache.popitem(last=False)
+            resolved[model_id] = model
         return resolved
 
 
@@ -154,13 +181,15 @@ class Gather(QueueCommunicator):
     """
 
     CACHED_VERBS = ("model",)
+    CACHE_CAPACITY = 4  # per verb; epochs advance, so old keys go cold
 
     def __init__(self, args, conn, gather_id):
         print(f"started gather {gather_id}")
         self.gather_id = gather_id
         self.learner_conn = conn
         self.job_queue = deque()
-        self.reply_cache = {verb: {} for verb in self.CACHED_VERBS}
+        self.reply_cache = {
+            verb: OrderedDict() for verb in self.CACHED_VERBS}
         self.pending_uploads = {}
         self.pending_count = 0
 
@@ -194,8 +223,12 @@ class Gather(QueueCommunicator):
 
     def _serve_cached(self, conn, verb, key):
         cache = self.reply_cache[verb]
-        if key not in cache:
+        if key in cache:
+            cache.move_to_end(key)
+        else:
             cache[key] = self._ask_learner((verb, key))
+            while len(cache) > self.CACHE_CAPACITY:
+                cache.popitem(last=False)
         self.send(conn, cache[key])
 
     def _stage_upload(self, conn, verb, payload):
