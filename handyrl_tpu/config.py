@@ -69,6 +69,10 @@ class TrainConfig:
     env: Dict[str, Any] = field(default_factory=dict)
 
     # --- TPU-native additions (absent from the reference) ---
+    # concurrent lockstep episodes per actor process: every rollout
+    # step runs ONE (episodes x players)-row batched CPU forward
+    # instead of one dispatch per seat; 1 = sequential fallback
+    lockstep_episodes: int = 16
     # device mesh shape for the learner, e.g. {"dp": 4}; empty = single chip
     mesh: Dict[str, int] = field(default_factory=dict)
     # number of device-resident batches to keep prefetched

@@ -84,6 +84,17 @@ class TPUModel:
         out = self._jitted(self.params, obs_b, hidden_b)
         return jax.tree.map(lambda a: np.asarray(a)[0], out)
 
+    def inference_batch(self, obs, hidden=None) -> Dict[str, Any]:
+        """Batched actor forward: numpy ``(N, ...)`` leaves in and out.
+
+        The RolloutPool's hot path — one dispatch covers every seat of
+        every lockstep episode.  Shares the jit cache with
+        ``inference`` (a second trace for the batched shape)."""
+        if self._jitted is None:
+            self._jitted = jax.jit(self.apply)
+        out = self._jitted(self.params, obs, hidden)
+        return jax.tree.map(np.asarray, out)
+
     # -- serialization (learner -> actor shipping) -------------------
     def __getstate__(self):
         return {"module": self.module, "params": _to_numpy(self.params)}
@@ -114,3 +125,11 @@ class RandomModel:
 
     def inference(self, obs=None, hidden=None):
         return dict(self._outputs)
+
+    def inference_batch(self, obs, hidden=None):
+        """Zero logits for every row of the batch (uniform policy)."""
+        n = jax.tree.leaves(obs)[0].shape[0]
+        return {
+            k: np.broadcast_to(v, (n,) + v.shape)
+            for k, v in self._outputs.items()
+        }

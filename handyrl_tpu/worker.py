@@ -120,8 +120,14 @@ class ModelCache:
 
 
 class Worker:
-    """One actor process: pull a job, resolve its models, roll out an
-    episode or an evaluation match, push the result back."""
+    """One actor process: pull jobs, resolve their models, roll out
+    episodes and evaluation matches, push the results back.
+
+    With ``lockstep_episodes > 1`` (the default) jobs run through a
+    RolloutPool: K episodes advance together and each step issues one
+    batched CPU forward across every seat, instead of one batch-1
+    dispatch per seat per step.  Jobs the pool cannot take (mixed
+    model snapshots) fall back to the sequential path."""
 
     def __init__(self, args, conn, wid):
         print(f"opened worker {wid}")
@@ -132,7 +138,7 @@ class Worker:
 
         from .environment import make_env
         from .evaluation import Evaluator
-        from .generation import Generator
+        from .generation import Generator, RolloutPool
 
         self.env = make_env({**args["env"], "id": wid})
         self.models = ModelCache(conn, self.env)
@@ -143,19 +149,48 @@ class Worker:
             "g": (generator.execute, "episode"),
             "e": (evaluator.execute, "result"),
         }
+        lockstep = int(self.args.get("lockstep_episodes", 1) or 1)
+        self.pool = None
+        if lockstep > 1:
+            # the pool gets its own envs: self.env backs the sequential
+            # fallback and the ModelCache (which resets it)
+            envs = [make_env({**args["env"], "id": wid})
+                    for _ in range(lockstep)]
+            self.pool = RolloutPool(envs, self.args)
 
     def __del__(self):
         print(f"closed worker {self.worker_id}")
 
-    def _run_job(self, job):
+    def _resolve(self, job):
         id_by_player = job.get("model_id", {})
-        pool = self.models.resolve(list(id_by_player.values()))
-        models = {p: pool[mid] for p, mid in id_by_player.items()}
+        resolved = self.models.resolve(list(id_by_player.values()))
+        return {p: resolved[mid] for p, mid in id_by_player.items()}
+
+    def _run_job(self, job):
+        models = self._resolve(job)
         runner, reply_verb = self.roles[job["role"]]
         send_recv(self.conn, (reply_verb, runner(models, job)))
 
+    def _run_lockstep(self):
+        pool = self.pool
+        while True:
+            while pool.has_free_slot():
+                job = send_recv(self.conn, ("args", None))
+                if job is None:
+                    return  # learner is done; drop in-flight episodes
+                if not pool.accepts(job):
+                    self._run_job(job)
+                    continue
+                for verb, payload in pool.assign(job, self._resolve(job)):
+                    send_recv(self.conn, (verb, payload))
+            for verb, payload in pool.step():
+                send_recv(self.conn, (verb, payload))
+
     def run(self):
         try:
+            if self.pool is not None:
+                self._run_lockstep()
+                return
             while True:
                 job = send_recv(self.conn, ("args", None))
                 if job is None:
