@@ -1,17 +1,29 @@
-"""Benchmark: learner + actor throughput vs the measured reference.
+"""Benchmark: learner + actor + pipeline throughput vs the measured
+reference.
 
 Prints ONE JSON line:
   {"metric", "value", "unit", "vs_baseline", ...extras}
 
 Headline: jitted update-step throughput on GeeseNet at batch 256 with
-bf16 compute on device-resident batches — the production path (the
-Trainer's DevicePrefetcher stages batches in HBM so the step never
-waits on H2D).  ``vs_baseline`` is a REAL ratio against the reference
-implementation's own update loop measured on this host by
-scripts/measure_reference_baseline.py (BASELINE_MEASURED.json).
-Extras: float32 + batch-64 + host-transfer-bound numbers, actor
-env-frames/sec from a CPU subprocess (production actor config), and an
-achieved-FLOPs / MFU estimate from analytic conv FLOP counting.
+bf16 compute on device-resident batches.  ``vs_baseline`` is a REAL
+ratio against the reference implementation's own update loop measured
+on this host at the SAME batch geometry by
+scripts/measure_reference_baseline.py (BASELINE_MEASURED.json — the
+reference trains one seat per simultaneous-game episode, so the true
+flagship batch is (256, 8, 1, 7, 11, 17)).
+
+Extras:
+  * measured (blocked) per-step device time + MFU from it — FLOPs are
+    derived from the actual batch geometry and kernel shapes, not
+    assumed constants;
+  * end-to-end pipeline steps/s: batcher processes -> device prefetch
+    (compact wire formats) -> update step, i.e. production training
+    minus the actor plane, with the batch_wait/update split;
+  * actor env-frames/sec from a CPU subprocess running the production
+    RolloutPool (lockstep batched inference), plus the sequential
+    number and a TicTacToe ratio against the measured reference actor;
+  * episode-intake rate of the full WorkerCluster gather tree with 32
+    actor processes.
 """
 
 import json
@@ -21,7 +33,7 @@ import sys
 import time
 
 BATCH = 256
-SEED_EPS = 8
+SEED_EPS = 32          # distinct self-play episodes behind the batch
 R1_GEOMETRY_BATCH = 64
 
 # bf16 peak TFLOP/s per chip by device kind (public specs); used only
@@ -44,7 +56,7 @@ def _tile(batch, reps):
         lambda v: np.tile(v, (reps,) + (1,) * (v.ndim - 1)), batch)
 
 
-def model_flops_per_sample(params, board_cells=7 * 11):
+def model_flops_per_sample(params, board_cells):
     """Analytic forward FLOPs per sample from the kernels:
     2 * spatial * kh * kw * cin * cout per conv, 2 * din * dout dense."""
     import jax
@@ -60,32 +72,59 @@ def model_flops_per_sample(params, board_cells=7 * 11):
     return total
 
 
+def batch_geometry(batch):
+    """(samples per step, board cells) read off the actual batch —
+    the forward flattens (B, T, P_in) into its batch dimension."""
+    import jax
+
+    obs = jax.tree.leaves(batch["observation"])[0]
+    b, t, p_in = obs.shape[:3]
+    cells = 1
+    for d in obs.shape[3:-1]:
+        cells *= d
+    return b * t * p_in, cells
+
+
+def _encode(batch, cfg):
+    """Re-encode a float32 seed batch into the configured wire format."""
+    from handyrl_tpu.batch import _encode_obs
+
+    out = dict(batch)
+    out["observation"] = _encode_obs(
+        batch["observation"], cfg.get("transfer_dtype"))
+    return out
+
+
 def measure_learner(seed, batch_size, compute_dtype, iters=30,
-                    host_iters=5, n_variants=4):
+                    host_iters=5, n_variants=4, timed_iters=10):
     """Update-step steps/sec at ``batch_size``.
 
-    Returns (resident_sps, host_sps): device-resident batches (the
-    production path — batches staged in HBM by the prefetcher) and
-    host-numpy batches (every step pays the full H2D transfer).
-    Distinct batch permutations are cycled so constant data cannot
-    flatter caching.
+    Returns (resident_sps, host_sps, step_ms): device-resident batches
+    (the production path — batches staged in HBM by the prefetcher),
+    host-numpy batches in the production wire format (every step pays
+    the full staging + transfer), and the median blocked per-step
+    device time in ms.  Distinct batch permutations are cycled so
+    constant data cannot flatter caching.
     """
     import jax
     import jax.numpy as jnp
     import numpy as np
 
+    from handyrl_tpu.learner import _stage_batch
     from handyrl_tpu.ops.losses import LossConfig
     from handyrl_tpu.ops.update import make_optimizer, make_update_step
 
     model, seed_batch, cfg = seed
+    wire_cfg = dict(cfg, transfer_dtype="uint8")  # geese planes: binary
 
     rng = np.random.default_rng(0)
     variants = []
     for _ in range(n_variants):
         perm = rng.permutation(SEED_EPS)
         shuffled = jax.tree.map(lambda v: v[perm], seed_batch)
-        variants.append(_tile(shuffled, batch_size // SEED_EPS))
-    resident = [jax.device_put(v) for v in variants]
+        variants.append(
+            _encode(_tile(shuffled, batch_size // SEED_EPS), wire_cfg))
+    resident = [_stage_batch(v, None, compute_dtype) for v in variants]
 
     loss_cfg = LossConfig.from_config(cfg)
     optimizer = make_optimizer(1e-3)
@@ -106,28 +145,207 @@ def measure_learner(seed, batch_size, compute_dtype, iters=30,
     float(metrics["total"])  # sync
     resident_sps = iters / (time.perf_counter() - t0)
 
+    # blocked per-step timing: sync every step so the number is the
+    # true device latency, not dispatch pipelining
+    step_ms = []
+    for i in range(timed_iters):
+        t0 = time.perf_counter()
+        params, opt_state, metrics = update(
+            params, opt_state, resident[i % n_variants])
+        float(metrics["total"])
+        step_ms.append((time.perf_counter() - t0) * 1e3)
+    step_ms.sort()
+    median_ms = step_ms[len(step_ms) // 2] if step_ms else None
+
     host_sps = None
     if host_iters:
         t0 = time.perf_counter()
         for i in range(host_iters):
-            params, opt_state, metrics = update(
-                params, opt_state, variants[i % n_variants])
+            staged = _stage_batch(
+                variants[i % n_variants], None, compute_dtype)
+            params, opt_state, metrics = update(params, opt_state, staged)
         float(metrics["total"])  # sync
         host_sps = host_iters / (time.perf_counter() - t0)
-    return resident_sps, host_sps
+    return resident_sps, host_sps, median_ms
+
+
+def measure_prefetch(seed, batch_size, compute_dtype, steps=40,
+                     n_variants=4):
+    """Transfer-pipeline throughput: pre-built host batches in the
+    production wire format stream through the threaded DevicePrefetcher
+    into the update step.  Isolates H2D staging + compute overlap from
+    host-side batch assembly (which scales with host cores)."""
+    import queue as _queue
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from handyrl_tpu.learner import DevicePrefetcher
+    from handyrl_tpu.ops.losses import LossConfig
+    from handyrl_tpu.ops.update import make_optimizer, make_update_step
+
+    model, seed_batch, cfg = seed
+    wire_cfg = dict(cfg, transfer_dtype="uint8")
+    rng = np.random.default_rng(1)
+    variants = []
+    for _ in range(n_variants):
+        perm = rng.permutation(SEED_EPS)
+        shuffled = jax.tree.map(lambda v: v[perm], seed_batch)
+        variants.append(
+            _encode(_tile(shuffled, batch_size // SEED_EPS), wire_cfg))
+
+    counter = {"i": 0}
+
+    def source(timeout=None):
+        i = counter["i"]
+        counter["i"] += 1
+        return variants[i % n_variants]
+
+    loss_cfg = LossConfig.from_config(cfg)
+    optimizer = make_optimizer(1e-3)
+    params = jax.tree.map(jnp.array, model.params)
+    opt_state = optimizer.init(params)
+    update = make_update_step(
+        model, loss_cfg, optimizer, compute_dtype=compute_dtype)
+
+    prefetcher = DevicePrefetcher(
+        source, depth=3, threads=2, obs_float=compute_dtype)
+    batch = prefetcher.get(timeout=120)
+    params, opt_state, metrics = update(params, opt_state, batch)
+    float(metrics["total"])  # compile + warmup
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        batch = prefetcher.get(timeout=120)
+        params, opt_state, metrics = update(params, opt_state, batch)
+    float(metrics["total"])
+    sps = steps / (time.perf_counter() - t0)
+    prefetcher.stop()
+    return sps
+
+
+def measure_pipeline(seed, batch_size, compute_dtype, transfer_dtype,
+                     steps=30):
+    """End-to-end learner throughput: batcher processes sampling real
+    episodes -> compact wire batches -> threaded device prefetch ->
+    update step.  Production training minus the actor plane."""
+    from collections import deque
+
+    import jax
+    import jax.numpy as jnp
+
+    from handyrl_tpu.learner import Batcher, DevicePrefetcher
+    from handyrl_tpu.ops.losses import LossConfig
+    from handyrl_tpu.ops.update import make_optimizer, make_update_step
+    from handyrl_tpu.utils.profiling import SectionTimers
+
+    model, _, cfg, episodes = seed
+    args = dict(cfg)
+    args.update(
+        batch_size=batch_size, num_batchers=2,
+        maximum_episodes=len(episodes),
+        compute_dtype=compute_dtype, transfer_dtype=transfer_dtype,
+    )
+    buffer = deque(episodes)
+    batcher = Batcher(args, buffer)
+    batcher.run()
+    prefetcher = DevicePrefetcher(
+        batcher.batch, depth=3, threads=2, obs_float=compute_dtype)
+
+    loss_cfg = LossConfig.from_config(cfg)
+    optimizer = make_optimizer(1e-3)
+    params = jax.tree.map(jnp.array, model.params)
+    opt_state = optimizer.init(params)
+    update = make_update_step(
+        model, loss_cfg, optimizer, compute_dtype=compute_dtype)
+
+    batch = prefetcher.get(timeout=120)
+    params, opt_state, metrics = update(params, opt_state, batch)
+    float(metrics["total"])  # compile + warmup
+
+    timers = SectionTimers()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        with timers.section("batch_wait"):
+            batch = prefetcher.get(timeout=120)
+        with timers.section("update"):
+            params, opt_state, metrics = update(params, opt_state, batch)
+    float(metrics["total"])  # sync
+    sps = steps / (time.perf_counter() - t0)
+
+    prefetcher.stop()
+    batcher.shutdown()
+    snap = timers.snapshot()
+    return sps, {name: v["sec"] for name, v in snap.items()}
+
+
+# ---------------------------------------------------------------------
+# actor benchmarks (CPU subprocess, like production workers)
+# ---------------------------------------------------------------------
+
+def _pool_throughput(env_name, cfg, k, target_episodes, seed=0):
+    import random
+
+    from handyrl_tpu.environment import make_env
+    from handyrl_tpu.generation import RolloutPool
+    from handyrl_tpu.models import TPUModel
+
+    random.seed(seed)
+    envs = [make_env({"env": env_name}) for _ in range(k)]
+    envs[0].reset()
+    model = TPUModel(envs[0].net())
+    model.init_params(
+        envs[0].observation(envs[0].players()[0]), seed=seed)
+    pool = RolloutPool(envs, cfg)
+    players = envs[0].players()
+    job = {"role": "g", "player": players,
+           "model_id": {p: 1 for p in players}}
+    models = {p: model for p in players}
+    while pool.has_free_slot():
+        pool.assign(job, models)
+    pool.step()  # compile
+
+    done, steps = 0, 0
+    t0 = time.perf_counter()
+    while done < target_episodes:
+        for verb, payload in pool.step():
+            if payload is not None:
+                done += 1
+                steps += payload["steps"]
+            if pool.has_free_slot():
+                pool.assign(job, models)
+    dt = time.perf_counter() - t0
+    return steps / dt, steps * len(players) / dt
 
 
 def actor_child():
-    """CPU actor benchmark body (run in a subprocess with
-    JAX_PLATFORMS=cpu, like production workers)."""
+    """CPU actor benchmark body (run in a subprocess, pinned to the
+    CPU backend exactly like production workers — a host sitecustomize
+    may outrank the JAX_PLATFORMS env var and point 'CPU' actors at
+    the tunneled TPU, which is both slow and contended)."""
     import random
+
+    from handyrl_tpu.connection import force_cpu_jax
+
+    force_cpu_jax()
+
+    from __graft_entry__ import GEESE_CFG, TTT_CFG
 
     from handyrl_tpu.environment import make_env
     from handyrl_tpu.generation import Generator
     from handyrl_tpu.models import TPUModel
 
-    from __graft_entry__ import GEESE_CFG
+    cfg = dict(GEESE_CFG, eval={"opponent": ["random"]})
+    geese_sps, geese_fps = _pool_throughput(
+        "HungryGeese", cfg, k=16, target_episodes=40)
 
+    ttt_cfg = dict(TTT_CFG, eval={"opponent": ["random"]})
+    ttt_sps, _ = _pool_throughput(
+        "TicTacToe", ttt_cfg, k=16, target_episodes=400)
+
+    # sequential fallback (the r1/r2 shape: one batch-1 dispatch per
+    # seat per step) for the speedup denominator
     random.seed(0)
     env = make_env({"env": "HungryGeese"})
     env.reset()
@@ -137,42 +355,125 @@ def actor_child():
     players = env.players()
     job = {"player": players, "model_id": {p: 1 for p in players}}
     models = {p: model for p in players}
-
-    # warmup (compile the CPU inference)
-    gen.generate(models, job)
-
-    episodes = 4
-    steps = 0
+    gen.generate(models, job)  # warmup
+    steps, done = 0, 0
     t0 = time.perf_counter()
-    done = 0
-    while done < episodes:
+    while done < 2:
         ep = gen.generate(models, job)
         if ep is None:
             continue
         steps += ep["steps"]
         done += 1
-    dt = time.perf_counter() - t0
+    seq_dt = time.perf_counter() - t0
     n_players = len(players)
+
     print(json.dumps({
-        "env_steps_per_sec": steps / dt,
-        "env_frames_per_sec": steps * n_players / dt,
+        "env_steps_per_sec": geese_sps,
+        "env_frames_per_sec": geese_fps,
+        "env_frames_per_sec_sequential": steps * n_players / seq_dt,
+        "actor_env_steps_per_sec_ttt": ttt_sps,
     }))
 
 
-def measure_actor():
+def intake_child():
+    """Episode-intake rate of the production gather tree: 32 actor
+    processes x 8 lockstep episodes on TicTacToe, uniform-policy jobs
+    (model_id 0), against a minimal in-process job server."""
+    import queue
+
+    from handyrl_tpu.connection import force_cpu_jax
+
+    force_cpu_jax()
+
+    from handyrl_tpu.environment import make_env
+    from handyrl_tpu.models import TPUModel, RandomModel  # noqa: F401
+    from handyrl_tpu.worker import WorkerCluster
+    import pickle
+
+    args = {
+        "turn_based_training": True, "observation": False,
+        "gamma": 0.8, "forward_steps": 8, "burn_in_steps": 0,
+        "compress_steps": 4, "lambda": 0.7,
+        "policy_target": "TD", "value_target": "TD",
+        "seed": 0, "lockstep_episodes": 8,
+        "eval": {"opponent": ["random"]},
+        "env": {"env": "TicTacToe"},
+        "worker": {"num_parallel": 32},
+    }
+    env = make_env(args["env"])
+    env.reset()
+    model = TPUModel(env.net())
+    model.init_params(env.observation(0), seed=0)
+    model_blob = pickle.dumps(model)
+    players = env.players()
+    job = {"role": "g", "player": players,
+           "model_id": {p: 0 for p in players}}
+
+    cluster = WorkerCluster(args)
+    cluster.run()
+
+    episodes = 0
+    t_start = time.perf_counter()
+    measure_from = None
+    measured_eps = 0
+    window = 20.0
+    while True:
+        now = time.perf_counter()
+        if measure_from is not None and now - measure_from > window:
+            break
+        if now - t_start > 180:  # startup guard
+            break
+        try:
+            conn, (verb, payload) = cluster.recv(timeout=0.3)
+        except queue.Empty:
+            continue
+        batched = isinstance(payload, list)
+        n = len(payload) if batched else 1
+        if verb == "args":
+            reply = [dict(job) for _ in range(n)]
+        elif verb == "model":
+            reply = [model_blob] * n
+        else:
+            if verb == "episode":
+                episodes += n
+                if measure_from is None and episodes >= 64:
+                    # warmup done: all workers are up and generating
+                    measure_from = now
+                    measured_eps = episodes
+            reply = [None] * n
+        cluster.send(conn, reply if batched else reply[0])
+    if measure_from is None:
+        # warmup never completed: report the failure, not a made-up rate
+        print(json.dumps({
+            "intake_error": "warmup_timeout",
+            "intake_episodes_seen": episodes,
+            "intake_workers": 32,
+        }))
+        sys.stdout.flush()
+        os._exit(0)
+    dt = time.perf_counter() - measure_from
+    print(json.dumps({
+        "intake_episodes_per_sec": (episodes - measured_eps) / dt,
+        "intake_workers": 32,
+    }))
+    sys.stdout.flush()
+    os._exit(0)  # gathers exit on EOF; skip the non-daemonic joins
+
+
+def _run_child(flag, timeout=1200):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     proc = subprocess.run(
-        [sys.executable, os.path.abspath(__file__), "--actor-child"],
+        [sys.executable, os.path.abspath(__file__), flag],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         text=True, cwd=os.path.dirname(os.path.abspath(__file__)),
-        timeout=1200,
+        timeout=timeout,
     )
     if proc.returncode != 0:
         tail = "\n".join(proc.stderr.splitlines()[-5:])
-        print(f"actor bench child failed (rc={proc.returncode}): {tail}",
+        print(f"bench child {flag} failed (rc={proc.returncode}): {tail}",
               file=sys.stderr)
-        return {"actor_bench_error": proc.returncode}
+        return {f"child_error{flag.replace('-', '_')}": proc.returncode}
     for line in reversed(proc.stdout.splitlines()):
         line = line.strip()
         if line.startswith("{"):
@@ -185,16 +486,24 @@ def main():
 
     from __graft_entry__ import _build_model_and_batch
 
-    # real self-play seed episodes (uniform rollout policy), generated
-    # once and tiled/permuted per geometry
-    seed = _build_model_and_batch(
-        batch_size=SEED_EPS, env_name="HungryGeese")
+    seed4 = _build_model_and_batch(
+        batch_size=SEED_EPS, return_episodes=True)
+    seed = seed4[:3]
+    model, seed_batch, cfg = seed
 
-    sps_bf16, sps_bf16_host = measure_learner(seed, BATCH, "bfloat16")
-    sps_f32, _ = measure_learner(seed, BATCH, "float32", iters=20,
-                                 host_iters=0)
-    sps64_bf16, _ = measure_learner(seed, R1_GEOMETRY_BATCH, "bfloat16",
-                                    iters=20, host_iters=0)
+    sps_bf16, sps_bf16_host, step_ms = measure_learner(
+        seed, BATCH, "bfloat16")
+    sps_f32, _, _ = measure_learner(seed, BATCH, "float32", iters=20,
+                                    host_iters=0, timed_iters=0)
+    sps64_bf16, _, _ = measure_learner(seed, R1_GEOMETRY_BATCH,
+                                       "bfloat16", iters=20,
+                                       host_iters=0, timed_iters=0)
+    sps1024_bf16, _, _ = measure_learner(seed, 1024, "bfloat16",
+                                         iters=15, host_iters=0,
+                                         timed_iters=0)
+    prefetch_sps = measure_prefetch(seed, BATCH, "bfloat16")
+    e2e_sps, e2e_prof = measure_pipeline(
+        seed4, BATCH, "bfloat16", "uint8")
 
     baseline = {}
     try:
@@ -210,35 +519,56 @@ def main():
         "learner_steps_per_sec_b256_f32": round(sps_f32, 2),
         "learner_steps_per_sec_b256_bf16_hostbatch": round(
             sps_bf16_host, 2),
+        "learner_steps_per_sec_b256_prefetch": round(prefetch_sps, 2),
+        "learner_steps_per_sec_b256_e2e": round(e2e_sps, 2),
+        "e2e_batch_wait_sec": e2e_prof.get("batch_wait"),
+        "e2e_update_sec": e2e_prof.get("update"),
         "learner_steps_per_sec_b64_bf16": round(sps64_bf16, 2),
+        "learner_steps_per_sec_b1024_bf16": round(sps1024_bf16, 2),
         "reference_steps_per_sec_b256_torch_cpu": ref256,
         "reference_steps_per_sec_b64_torch_cpu":
             baseline.get("learner_steps_per_sec"),
     }
 
-    model, seed_batch, cfg = seed
-    samples = BATCH * cfg["forward_steps"] * 4  # B * T * P
+    samples, cells = batch_geometry(
+        _tile(seed_batch, BATCH // SEED_EPS))
     # fwd + bwd ~= 3x forward FLOPs
-    flops_step = 3.0 * samples * model_flops_per_sample(model.params)
-    achieved = flops_step * sps_bf16 / 1e12
+    flops_step = 3.0 * samples * model_flops_per_sample(
+        model.params, cells)
     extras["flops_per_step_est"] = flops_step
+    extras["samples_per_step"] = samples
+    # pipelined time is the real sustained per-step cost; the blocked
+    # time additionally pays one full host<->device sync per step (on
+    # tunneled dev hosts that is dominated by tunnel RTT, not compute)
+    extras["step_time_ms_pipelined"] = round(1e3 / sps_bf16, 3)
+    extras["step_time_ms_blocked_incl_sync"] = round(step_ms, 3)
+    achieved = flops_step * sps_bf16 / 1e12
     extras["achieved_tflops_est"] = round(achieved, 2)
     kind = jax.devices()[0].device_kind
     extras["device_kind"] = kind
     peak = PEAK_TFLOPS.get(kind)
     if peak:
-        extras["mfu_est"] = round(achieved / peak, 4)
+        extras["mfu_measured"] = round(achieved / peak, 4)
 
-    extras.update(measure_actor())
-    for key in ("env_frames_per_sec", "env_steps_per_sec"):
-        if key in extras:
+    extras.update(_run_child("--actor-child"))
+    extras.update(_run_child("--intake-child", timeout=600))
+    ref_actor = baseline.get("actor_env_steps_per_sec_ttt")
+    if ref_actor and extras.get("actor_env_steps_per_sec_ttt"):
+        extras["reference_actor_env_steps_per_sec_ttt"] = ref_actor
+        extras["actor_vs_reference_ttt"] = round(
+            extras["actor_env_steps_per_sec_ttt"] / ref_actor, 2)
+    for key in ("env_frames_per_sec", "env_steps_per_sec",
+                "env_frames_per_sec_sequential",
+                "actor_env_steps_per_sec_ttt",
+                "intake_episodes_per_sec"):
+        if isinstance(extras.get(key), float):
             extras[key] = round(extras[key], 1)
 
     print(json.dumps({
         "metric": "learner_update_steps_per_sec",
         "value": round(sps_bf16, 2),
         "unit": (f"steps/sec (GeeseNet bf16, device-resident "
-                 f"batch={BATCH}x{cfg['forward_steps']}x4p)"),
+                 f"batch={BATCH}x{cfg['forward_steps']}x1p solo)"),
         "vs_baseline": round(vs, 3),
         **extras,
     }))
@@ -247,5 +577,7 @@ def main():
 if __name__ == "__main__":
     if "--actor-child" in sys.argv:
         actor_child()
+    elif "--intake-child" in sys.argv:
+        intake_child()
     else:
         main()

@@ -27,18 +27,66 @@ Batch layout (B = batch, T = time, P = players, A = actions):
 import bz2
 import pickle
 import random
+from collections import OrderedDict
 
 import numpy as np
+
+try:
+    import ml_dtypes
+
+    BF16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover - ml_dtypes ships with jax
+    BF16 = None
 
 from .utils.tree import tree_map, tree_stack, stack_time_player
 
 ILLEGAL = np.float32(1e32)
 
 
+# Per-block decompress cache: recency-biased sampling draws the same
+# episodes many times per epoch, and each draw used to pay the full
+# bz2 inflate again.  Keyed by the compressed bytes themselves (blocks
+# arrive as fresh objects over the batcher pipe, so identity keys
+# would never hit).  Read-only: _episode_tensors never mutates moments.
+# Bounded by decompressed BYTES, not entry count — custom envs can have
+# MB-scale observations per block.
+_BLOCK_CACHE = OrderedDict()  # blob -> (block, nbytes)
+_BLOCK_CACHE_MAX_BYTES = 512 * 1024 * 1024  # per batcher process
+_block_cache_bytes = 0
+
+
+def _block_nbytes(block):
+    total = 0
+    for moment in block:
+        for channel in moment.values():
+            if isinstance(channel, dict):
+                for v in channel.values():
+                    total += getattr(v, "nbytes", 32)
+            else:
+                total += getattr(channel, "nbytes", 32)
+    return total
+
+
+def _inflate_block(blob):
+    global _block_cache_bytes
+    hit = _BLOCK_CACHE.get(blob)
+    if hit is not None:
+        _BLOCK_CACHE.move_to_end(blob)
+        return hit[0]
+    block = pickle.loads(bz2.decompress(blob))
+    nbytes = _block_nbytes(block)
+    if nbytes <= _BLOCK_CACHE_MAX_BYTES // 4:
+        _BLOCK_CACHE[blob] = (block, nbytes)
+        _block_cache_bytes += nbytes
+        while _block_cache_bytes > _BLOCK_CACHE_MAX_BYTES:
+            _, (_, freed) = _BLOCK_CACHE.popitem(last=False)
+            _block_cache_bytes -= freed
+    return block
+
+
 def decompress_moments(ep):
     """Inflate an episode's bz2 moment blocks and slice to [start, end)."""
-    blocks = [pickle.loads(bz2.decompress(blob)) for blob in ep["moment"]]
-    moments = [m for block in blocks for m in block]
+    moments = [m for blob in ep["moment"] for m in _inflate_block(blob)]
     return moments[ep["start"] - ep["base"]: ep["end"] - ep["base"]]
 
 
@@ -170,7 +218,14 @@ def _episode_tensors(ep, cfg):
 
 
 def make_batch(episodes, cfg):
-    """Assemble a ``(B, T, P, ...)`` training batch from episode slices."""
+    """Assemble a ``(B, T, P, ...)`` training batch from episode slices.
+
+    With ``transfer_dtype: bfloat16`` the observation tree — by far the
+    largest tensor — is emitted in bf16, halving host->device transfer
+    bytes.  The update step computes in bf16 anyway under the default
+    ``compute_dtype``, so the cast costs nothing numerically; all the
+    small mask/target tensors stay float32.
+    """
     obs_list, datum = [], []
     for ep in episodes:
         obs, row = _episode_tensors(ep, cfg)
@@ -178,5 +233,34 @@ def make_batch(episodes, cfg):
         datum.append(row)
 
     batch = {k: np.stack([d[k] for d in datum]) for k in datum[0]}
-    batch["observation"] = tree_stack(obs_list)
+    batch["observation"] = _encode_obs(
+        tree_stack(obs_list), cfg.get("transfer_dtype"))
     return batch
+
+
+def _encode_obs(obs, transfer_dtype):
+    """Compact-transfer encodings for the observation tree (only the
+    floating leaves; the update step restores the compute dtype on
+    device).  ``uint8`` is opt-in for envs whose observations are
+    integer-valued planes (binary boards): it quarters transfer bytes
+    and is verified exact here, off the learner's critical path."""
+    if transfer_dtype == "bfloat16" and BF16 is not None:
+        return tree_map(
+            lambda a: a.astype(BF16)
+            if np.issubdtype(a.dtype, np.floating) else a,
+            obs,
+        )
+    if transfer_dtype == "uint8":
+        def quantize(a):
+            if not np.issubdtype(a.dtype, np.floating):
+                return a
+            q = a.astype(np.uint8)
+            if not np.array_equal(q.astype(a.dtype), a):
+                raise ValueError(
+                    "transfer_dtype 'uint8' requires integer-valued "
+                    "observations in [0, 255]; this env's observations "
+                    "are not — use 'bfloat16' instead")
+            return q
+
+        return tree_map(quantize, obs)
+    return obs

@@ -77,6 +77,13 @@ class TrainConfig:
     mesh: Dict[str, int] = field(default_factory=dict)
     # number of device-resident batches to keep prefetched
     prefetch_batches: int = 2
+    # background host->device transfer threads feeding the prefetch
+    transfer_threads: int = 2
+    # observation wire format for host->device transfer:
+    #   auto     — bfloat16 when compute_dtype is bfloat16, else float32
+    #   uint8    — quarter-width, for integer-valued (binary-plane)
+    #              observations only (verified in the batcher)
+    transfer_dtype: str = "auto"
     # compute dtype for the update step: bfloat16 rides the MXU at
     # full rate (params/optimizer stay float32); set "float32" to
     # opt out for numerics debugging
@@ -99,6 +106,10 @@ class TrainConfig:
             raise ValueError("compress_steps must be >= 1")
         if not 0.0 <= self.eval_rate <= 1.0:
             raise ValueError("eval_rate must be in [0, 1]")
+        if self.transfer_dtype not in (
+                "auto", "float32", "bfloat16", "uint8"):
+            raise ValueError(
+                f"unknown transfer_dtype {self.transfer_dtype!r}")
 
     # The reference floors the eval rate so at least ~n^0.85 of every
     # update window is evaluation (/root/reference/handyrl/train.py:415).

@@ -38,7 +38,11 @@ GEESE_ARGS = {
 
 OBS_SHAPE = (17, 7, 11)  # reference GeeseNet input planes
 NUM_ACTIONS = 4
-NUM_PLAYERS = 4
+# the reference gathers ONE random seat per episode for simultaneous
+# games ("solo training", /root/reference/handyrl/train.py:57-58), so
+# the true training batch is (B, T, 1, ...) — P here is the batch's
+# player axis, not the game's player count
+NUM_PLAYERS = 1
 
 
 def synthetic_batch(torch, batch_size, steps):
@@ -108,6 +112,45 @@ def measure(batch_size, steps, iters, warmup=1):
     return iters / dt
 
 
+def measure_actor(iters=6):
+    """The reference actor hot loop on TicTacToe (its only env with no
+    external game dependency): Generator.generate with the torch conv
+    net through ModelWrapper — generation.py:31-73 semantics."""
+    sys.path.insert(0, REFERENCE_ROOT)
+    import random
+
+    import torch
+    torch.set_num_threads(1)  # actor procs are thread-pinned (model.py:6-11)
+
+    from handyrl.envs.tictactoe import Environment
+    from handyrl.generation import Generator
+    from handyrl.model import ModelWrapper
+
+    random.seed(0)
+    env = Environment()
+    model = ModelWrapper(env.net())
+    args = {
+        "turn_based_training": True, "observation": False,
+        "gamma": 0.8, "compress_steps": 4,
+    }
+    gen = Generator(env, args)
+    players = env.players()
+    job = {"player": players, "model_id": {p: 1 for p in players}}
+    models = {p: model for p in players}
+    gen.generate(models, job)  # warmup
+    steps = 0
+    t0 = time.perf_counter()
+    done = 0
+    while done < iters:
+        ep = gen.generate(models, job)
+        if ep is None:
+            continue
+        steps += ep["steps"]
+        done += 1
+    dt = time.perf_counter() - t0
+    return steps / dt
+
+
 def main():
     results = {
         "source": "reference handyrl (torch CPU) update loop on this host",
@@ -120,6 +163,11 @@ def main():
                else f"learner_steps_per_sec_b{batch_size}")
         results[key] = round(sps, 4)
         print(f"batch {batch_size}: {sps:.4f} steps/s")
+    actor_sps = measure_actor()
+    # TicTacToe is 2-player turn-based: frames == env steps (one seat
+    # observes per step)
+    results["actor_env_steps_per_sec_ttt"] = round(actor_sps, 2)
+    print(f"reference actor TicTacToe: {actor_sps:.2f} env-steps/s")
     out = os.path.join(os.path.dirname(__file__), "..",
                        "BASELINE_MEASURED.json")
     with open(out, "w") as f:

@@ -140,3 +140,72 @@ def test_update_learns_value_of_won_games():
         if first_v is None:
             first_v = float(metrics["v"])
     assert float(metrics["v"]) < first_v
+
+
+def test_bf16_transfer_round_trip():
+    """transfer_dtype=bfloat16 emits bf16 observations; staging ships
+    them as uint16 bit patterns and restores bf16 exactly on device;
+    the bf16 update step consumes the result."""
+    import jax
+    import jax.numpy as jnp
+    import ml_dtypes
+
+    from handyrl_tpu.learner import _stage_batch
+
+    _, episodes = _gen_episodes(4, seed=21)
+    sel = [_select(ep) for ep in episodes]
+    cfg16 = dict(CFG, transfer_dtype="bfloat16")
+    b32 = make_batch(sel, CFG)
+    b16 = make_batch(sel, cfg16)
+
+    assert b16["observation"].dtype == np.dtype(ml_dtypes.bfloat16)
+    assert b16["selected_prob"].dtype == np.float32  # small leaves stay
+    np.testing.assert_allclose(
+        b16["observation"].astype(np.float32),
+        b32["observation"].astype(np.float32), atol=1e-2)
+
+    staged = _stage_batch(b16, sharding=None)
+    assert staged["observation"].dtype == jnp.bfloat16
+    # the bitcast is exact: identical bit patterns
+    assert np.array_equal(
+        np.asarray(staged["observation"]).view(np.uint16),
+        b16["observation"].view(np.uint16))
+
+    env = TicTacToe()
+    env.reset()
+    model = TPUModel(env.net())
+    model.init_params(env.observation(env.turn()), seed=21)
+    optimizer = make_optimizer(1e-3)
+    update = make_update_step(
+        model, LossConfig.from_config(CFG), optimizer,
+        compute_dtype="bfloat16")
+    params = jax.tree.map(jnp.array, model.params)
+    params, _, metrics = update(params, optimizer.init(params), staged)
+    assert np.isfinite(float(metrics["total"]))
+
+
+def test_uint8_transfer_round_trip_and_guard():
+    """uint8 wire format: exact for binary-plane envs, rejected loudly
+    for non-integer observations."""
+    import jax.numpy as jnp
+
+    from handyrl_tpu.learner import _stage_batch
+
+    _, episodes = _gen_episodes(4, seed=22)
+    sel = [_select(ep) for ep in episodes]
+    cfg8 = dict(CFG, transfer_dtype="uint8")
+    b32 = make_batch(sel, CFG)
+    b8 = make_batch(sel, cfg8)
+    assert b8["observation"].dtype == np.uint8
+    assert b8["action"].dtype == np.int32
+
+    staged = _stage_batch(b8, sharding=None, obs_float="float32")
+    assert staged["observation"].dtype == jnp.float32
+    assert staged["action"].dtype == jnp.int32
+    np.testing.assert_array_equal(
+        np.asarray(staged["observation"]), b32["observation"])
+
+    # non-integer observations must be refused
+    from handyrl_tpu.batch import _encode_obs
+    with pytest.raises(ValueError, match="uint8"):
+        _encode_obs(b32["observation"] + 0.5, "uint8")

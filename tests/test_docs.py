@@ -1,0 +1,50 @@
+"""Docs <-> config consistency: docs/parameters.md must document every
+config key and must not document keys that do not exist, so the page
+cannot drift from handyrl_tpu/config.py."""
+
+import dataclasses
+import os
+import re
+
+from handyrl_tpu.config import TrainConfig, WorkerConfig
+
+DOCS = os.path.join(os.path.dirname(__file__), "..", "docs",
+                    "parameters.md")
+
+
+def _documented_keys():
+    with open(DOCS) as f:
+        text = f.read()
+    # keys are documented as "* `name`, type = ..." or "* `name`" bullets
+    return set(re.findall(r"^\s*\* `([a-z_]+)`", text, re.MULTILINE))
+
+
+def _config_keys():
+    keys = set()
+    for field in dataclasses.fields(TrainConfig):
+        if field.name == "env":
+            continue  # internal merged-env slot, not a YAML key
+        keys.add("lambda" if field.name == "lambda_" else field.name)
+    for field in dataclasses.fields(WorkerConfig):
+        keys.add(field.name)
+    keys.update({"env", "opponent"})  # env_args.env + eval.opponent
+    return keys
+
+
+def test_every_config_key_is_documented():
+    missing = _config_keys() - _documented_keys()
+    assert not missing, f"undocumented config keys: {sorted(missing)}"
+
+
+def test_no_phantom_keys_documented():
+    phantom = _documented_keys() - _config_keys()
+    assert not phantom, (
+        f"docs/parameters.md documents non-existent keys: "
+        f"{sorted(phantom)}")
+
+
+def test_docs_exist():
+    for name in ("api.md", "custom_environment.md",
+                 "large_scale_training.md", "parameters.md"):
+        path = os.path.join(os.path.dirname(DOCS), name)
+        assert os.path.exists(path), f"missing doc {name}"
