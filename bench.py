@@ -22,8 +22,8 @@ Extras:
   * actor env-frames/sec from a CPU subprocess running the production
     RolloutPool (lockstep batched inference), plus the sequential
     number and a TicTacToe ratio against the measured reference actor;
-  * episode-intake rate of the full WorkerCluster gather tree with 32
-    actor processes.
+  * episode-intake rate of the full WorkerCluster gather tree at 4, 16
+    and 32 actor processes (scaling table).
 """
 
 import json
@@ -375,10 +375,11 @@ def actor_child():
     }))
 
 
-def intake_child():
-    """Episode-intake rate of the production gather tree: 32 actor
-    processes x 8 lockstep episodes on TicTacToe, uniform-policy jobs
-    (model_id 0), against a minimal in-process job server."""
+def intake_child(num_parallel=32):
+    """Episode-intake rate of the production gather tree:
+    ``num_parallel`` actor processes x 8 lockstep episodes on
+    TicTacToe, uniform-policy jobs (model_id 0), against a minimal
+    in-process job server."""
     import queue
 
     from handyrl_tpu.connection import force_cpu_jax
@@ -398,7 +399,7 @@ def intake_child():
         "seed": 0, "lockstep_episodes": 8,
         "eval": {"opponent": ["random"]},
         "env": {"env": "TicTacToe"},
-        "worker": {"num_parallel": 32},
+        "worker": {"num_parallel": num_parallel},
     }
     env = make_env(args["env"])
     env.reset()
@@ -436,7 +437,9 @@ def intake_child():
         else:
             if verb == "episode":
                 episodes += n
-                if measure_from is None and episodes >= 64:
+                if (measure_from is None
+                        and episodes >= max(16, 2 * num_parallel)
+                        and now - t_start > 12.0):
                     # warmup done: all workers are up and generating
                     measure_from = now
                     measured_eps = episodes
@@ -447,24 +450,24 @@ def intake_child():
         print(json.dumps({
             "intake_error": "warmup_timeout",
             "intake_episodes_seen": episodes,
-            "intake_workers": 32,
+            "intake_workers": num_parallel,
         }))
         sys.stdout.flush()
         os._exit(0)
     dt = time.perf_counter() - measure_from
     print(json.dumps({
         "intake_episodes_per_sec": (episodes - measured_eps) / dt,
-        "intake_workers": 32,
+        "intake_workers": num_parallel,
     }))
     sys.stdout.flush()
     os._exit(0)  # gathers exit on EOF; skip the non-daemonic joins
 
 
-def _run_child(flag, timeout=1200):
+def _run_child(flag, timeout=1200, extra=()):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     proc = subprocess.run(
-        [sys.executable, os.path.abspath(__file__), flag],
+        [sys.executable, os.path.abspath(__file__), flag, *extra],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         text=True, cwd=os.path.dirname(os.path.abspath(__file__)),
         timeout=timeout,
@@ -551,7 +554,19 @@ def main():
         extras["mfu_measured"] = round(achieved / peak, 4)
 
     extras.update(_run_child("--actor-child"))
-    extras.update(_run_child("--intake-child", timeout=600))
+    # gather-tree scaling over the actor-process count
+    intake_scaling = {}
+    for n in (4, 16, 32):
+        result = _run_child("--intake-child", timeout=600, extra=[str(n)])
+        if "intake_episodes_per_sec" in result:
+            intake_scaling[str(n)] = round(
+                result["intake_episodes_per_sec"], 1)
+            if n == 32:
+                extras.update(result)  # the headline intake record
+        elif result:
+            extras[f"intake_error_w{n}"] = result.get(
+                "intake_error", "child_failed")
+    extras["intake_scaling_by_workers"] = intake_scaling
     ref_actor = baseline.get("actor_env_steps_per_sec_ttt")
     if ref_actor and extras.get("actor_env_steps_per_sec_ttt"):
         extras["reference_actor_env_steps_per_sec_ttt"] = ref_actor
@@ -578,6 +593,7 @@ if __name__ == "__main__":
     if "--actor-child" in sys.argv:
         actor_child()
     elif "--intake-child" in sys.argv:
-        intake_child()
+        tail = [a for a in sys.argv[2:] if a.isdigit()]
+        intake_child(int(tail[0]) if tail else 32)
     else:
         main()

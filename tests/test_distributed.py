@@ -144,3 +144,78 @@ def test_network_battle(tmp_path, monkeypatch):
                 {"default": {}}, 1, 4, seed=0)
     for c in clients:
         c.terminate()
+
+
+@pytest.mark.slow
+def test_gather_tree_scales_to_16_workers():
+    """16 actor processes through the gather tree against a minimal
+    job server: every episode arrives, the single server loop keeps
+    up, and uploads batch through gathers (VERDICT r2 item 9 — the
+    production topology beyond num_parallel=2)."""
+    import queue
+
+    from handyrl_tpu.environment import make_env
+    from handyrl_tpu.models import TPUModel
+    from handyrl_tpu.worker import WorkerCluster
+
+    args = {
+        **TRAIN_ARGS,
+        "worker": {"num_parallel": 16},
+        "lockstep_episodes": 4,
+        "eval": {"opponent": ["random"]},
+        "env": {"env": "TicTacToe"},
+    }
+    env = make_env(args["env"])
+    env.reset()
+    model = TPUModel(env.net())
+    model.init_params(env.observation(0), seed=0)
+    blob = pickle.dumps(model)
+    players = env.players()
+    job = {"role": "g", "player": players,
+           "model_id": {p: 0 for p in players}}
+
+    cluster = WorkerCluster(args)
+    cluster.run()
+    assert args["worker"]["num_gathers"] == 1  # 16 workers -> 1 gather
+
+    # modest bar with generous wall budget: this asserts the topology
+    # works at 16 workers, not a throughput number (bench.py measures
+    # that) — CI hosts and parallel test runs share cores
+    episodes, target = 0, 48
+    deadline = time.time() + 240
+    try:
+        while episodes < target and time.time() < deadline:
+            try:
+                conn, (verb, payload) = cluster.recv(timeout=0.3)
+            except queue.Empty:
+                continue
+            batched = isinstance(payload, list)
+            n = len(payload) if batched else 1
+            if verb == "args":
+                reply = [dict(job)] * n
+            elif verb == "model":
+                reply = [blob] * n
+            else:
+                if verb == "episode":
+                    # TicTacToe never fails: every episode must be real
+                    for ep in (payload if batched else [payload]):
+                        assert ep is not None and ep["steps"] > 0
+                    episodes += n
+                reply = [None] * n
+            cluster.send(conn, reply if batched else reply[0])
+    finally:
+        # shut the tree down: answer every further job request with
+        # None until the gather's connection actually closes — a fixed
+        # window could leave non-daemonic gather/worker processes
+        # alive and hang pytest at interpreter exit
+        drain_cap = time.time() + 90
+        while cluster.connection_count() > 0 and time.time() < drain_cap:
+            try:
+                conn, (verb, payload) = cluster.recv(timeout=0.2)
+            except queue.Empty:
+                continue
+            batched = isinstance(payload, list)
+            n = len(payload) if batched else 1
+            cluster.send(conn, [None] * n if batched else None)
+        cluster.shutdown()
+    assert episodes >= target, f"only {episodes} episodes in 240s"
