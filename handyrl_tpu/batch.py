@@ -43,50 +43,13 @@ from .utils.tree import tree_map, tree_stack, stack_time_player
 ILLEGAL = np.float32(1e32)
 
 
-# Per-block decompress cache: recency-biased sampling draws the same
-# episodes many times per epoch, and each draw used to pay the full
-# bz2 inflate again.  Keyed by the compressed bytes themselves (blocks
-# arrive as fresh objects over the batcher pipe, so identity keys
-# would never hit).  Read-only: _episode_tensors never mutates moments.
-# Bounded by decompressed BYTES, not entry count — custom envs can have
-# MB-scale observations per block.
-_BLOCK_CACHE = OrderedDict()  # blob -> (block, nbytes)
-_BLOCK_CACHE_MAX_BYTES = 512 * 1024 * 1024  # per batcher process
-_block_cache_bytes = 0
-
-
-def _block_nbytes(block):
-    total = 0
-    for moment in block:
-        for channel in moment.values():
-            if isinstance(channel, dict):
-                for v in channel.values():
-                    total += getattr(v, "nbytes", 32)
-            else:
-                total += getattr(channel, "nbytes", 32)
-    return total
-
-
-def _inflate_block(blob):
-    global _block_cache_bytes
-    hit = _BLOCK_CACHE.get(blob)
-    if hit is not None:
-        _BLOCK_CACHE.move_to_end(blob)
-        return hit[0]
-    block = pickle.loads(bz2.decompress(blob))
-    nbytes = _block_nbytes(block)
-    if nbytes <= _BLOCK_CACHE_MAX_BYTES // 4:
-        _BLOCK_CACHE[blob] = (block, nbytes)
-        _block_cache_bytes += nbytes
-        while _block_cache_bytes > _BLOCK_CACHE_MAX_BYTES:
-            _, (_, freed) = _BLOCK_CACHE.popitem(last=False)
-            _block_cache_bytes -= freed
-    return block
-
-
 def decompress_moments(ep):
-    """Inflate an episode's bz2 moment blocks and slice to [start, end)."""
-    moments = [m for blob in ep["moment"] for m in _inflate_block(blob)]
+    """Inflate an episode's bz2 moment blocks and slice to [start, end).
+
+    Uncached: the production batch path consumes the columnar cache
+    below; this raw-moment view serves tests and tooling."""
+    moments = [m for blob in ep["moment"]
+               for m in pickle.loads(bz2.decompress(blob))]
     return moments[ep["start"] - ep["base"]: ep["end"] - ep["base"]]
 
 
@@ -95,59 +58,71 @@ def _pad_time(arr, before, after, value=0.0):
     return np.pad(arr, pad, constant_values=value)
 
 
-def _episode_tensors(ep, cfg):
-    """Build one episode's (T, P, ...) tensors, padded to batch_steps."""
-    moments = decompress_moments(ep)
-    players = list(moments[0]["observation"].keys())
-    if not cfg["turn_based_training"]:
-        players = [random.choice(players)]
+# ---------------------------------------------------------------------
+# columnar block cache
+#
+# Recency-biased sampling draws the same episodes many times per epoch;
+# the per-draw cost used to be a Python walk over every moment dict.
+# Instead, each bz2 block is converted ONCE into stacked "columnar"
+# arrays over (T_block, P_all, ...) — all players, with presence masks —
+# and every draw then reduces to concatenate + slice + (turn-gather or
+# column-select) + pad, which is pure numpy.  Cached per compressed
+# blob (blocks arrive as fresh objects over the batcher pipe), bounded
+# by decompressed bytes.
+# ---------------------------------------------------------------------
 
+_COL_CACHE = OrderedDict()  # blob -> (columnar dict, nbytes)
+_COL_CACHE_MAX_BYTES = 512 * 1024 * 1024  # per batcher process
+_col_cache_bytes = 0
+
+
+def _nbytes_tree(x):
+    if isinstance(x, dict):
+        return sum(_nbytes_tree(v) for v in x.values())
+    if isinstance(x, (list, tuple)):
+        return sum(_nbytes_tree(v) for v in x)
+    return getattr(x, "nbytes", 8)
+
+
+def _build_columnar(moments):
+    """Stack one block's moments into (T, P_all, ...) arrays."""
+    players = list(moments[0]["observation"].keys())
     turn0 = moments[0]["turn"][0]
     obs_template = tree_map(
         lambda a: np.zeros_like(a), moments[0]["observation"][turn0]
     )
     num_actions = len(moments[0]["action_mask"][turn0])
 
-    if cfg["turn_based_training"] and not cfg["observation"]:
-        # one acting seat per step: gather the turn player's data (P_in = 1)
-        obs_rows = [[m["observation"][m["turn"][0]]] for m in moments]
-        prob = np.array(
-            [[[m["selected_prob"][m["turn"][0]]]] for m in moments], np.float32
-        )
-        act = np.array(
-            [[[m["action"][m["turn"][0]]]] for m in moments], np.int32
-        )
-        amask = np.array(
-            [[m["action_mask"][m["turn"][0]]] for m in moments], np.float32
-        )
-    else:
-        def pick(m, key, p, default):
-            v = m[key][p]
-            return default if v is None else v
+    def pick(m, key, p, default):
+        v = m[key][p]
+        return default if v is None else v
 
-        obs_rows = [[m["observation"][p] for p in players] for m in moments]
-        prob = np.array(
-            [[[pick(m, "selected_prob", p, 1.0)] for p in players] for m in moments],
-            np.float32,
-        )
-        act = np.array(
-            [[[pick(m, "action", p, 0)] for p in players] for m in moments], np.int32
-        )
-        amask = np.stack(
-            [
-                np.stack(
-                    [
-                        np.asarray(m["action_mask"][p], np.float32)
-                        if m["action_mask"][p] is not None
-                        else np.full(num_actions, ILLEGAL, np.float32)
-                        for p in players
-                    ]
-                )
-                for m in moments
-            ]
-        )
-
-    obs = stack_time_player(obs_rows, obs_template)  # tree of (T, P_in, ...)
+    obs = stack_time_player(
+        [[m["observation"][p] for p in players] for m in moments],
+        obs_template,
+    )
+    prob = np.array(
+        [[[pick(m, "selected_prob", p, 1.0)] for p in players]
+         for m in moments],
+        np.float32,
+    )
+    act = np.array(
+        [[[pick(m, "action", p, 0)] for p in players] for m in moments],
+        np.int32,
+    )
+    amask = np.stack(
+        [
+            np.stack(
+                [
+                    np.asarray(m["action_mask"][p], np.float32)
+                    if m["action_mask"][p] is not None
+                    else np.full(num_actions, ILLEGAL, np.float32)
+                    for p in players
+                ]
+            )
+            for m in moments
+        ]
+    )
 
     def channel(key):
         return np.array(
@@ -161,22 +136,128 @@ def _episode_tensors(ep, cfg):
             np.float32,
         ).reshape(len(moments), len(players), -1)
 
-    v = channel("value")
-    rew = channel("reward")
-    ret = channel("return")
+    tmask = np.array(
+        [[[m["selected_prob"][p] is not None] for p in players]
+         for m in moments],
+        np.float32,
+    )
+    omask = np.array(
+        [[[m["observation"][p] is not None] for p in players]
+         for m in moments],
+        np.float32,
+    )
+    turn_idx = np.array(
+        [players.index(m["turn"][0]) for m in moments], np.int64)
+
+    return {
+        "players": players,
+        "obs": obs,
+        "prob": prob,
+        "act": act,
+        "amask": amask,
+        "value": channel("value"),
+        "reward": channel("reward"),
+        "return": channel("return"),
+        "tmask": tmask,
+        "omask": omask,
+        "turn_idx": turn_idx,
+    }
+
+
+def _columnar_block(blob):
+    global _col_cache_bytes
+    hit = _COL_CACHE.get(blob)
+    if hit is not None:
+        _COL_CACHE.move_to_end(blob)
+        return hit[0]
+    col = _build_columnar(pickle.loads(bz2.decompress(blob)))
+    nbytes = _nbytes_tree(col)
+    if nbytes <= _COL_CACHE_MAX_BYTES // 4:
+        _COL_CACHE[blob] = (col, nbytes)
+        _col_cache_bytes += nbytes
+        while _col_cache_bytes > _COL_CACHE_MAX_BYTES:
+            _, (_, freed) = _COL_CACHE.popitem(last=False)
+            _col_cache_bytes -= freed
+    return col
+
+
+def _tree_cat_slice(trees, spans):
+    """Assemble the training window from per-block slices: each tree i
+    contributes rows ``spans[i]`` and the pieces are concatenated.
+    Slicing BEFORE concatenating copies only window bytes per draw."""
+    first = trees[0]
+    if isinstance(first, dict):
+        return {k: _tree_cat_slice([t[k] for t in trees], spans)
+                for k in first}
+    if isinstance(first, (list, tuple)):
+        return type(first)(
+            _tree_cat_slice([t[i] for t in trees], spans)
+            for i in range(len(first))
+        )
+    if len(trees) == 1:
+        a, b = spans[0]
+        return first[a:b]
+    return np.concatenate(
+        [t[a:b] for t, (a, b) in zip(trees, spans)])
+
+
+def _take_turn(arr, turn_idx):
+    """Gather each step's acting player's row: (T, P, ...) -> (T, 1, ...)."""
+    idx = turn_idx.reshape((len(turn_idx), 1) + (1,) * (arr.ndim - 2))
+    return np.take_along_axis(arr, idx, axis=1)
+
+
+def _episode_tensors(ep, cfg):
+    """Build one episode's (T, P, ...) tensors, padded to batch_steps."""
+    blocks = [_columnar_block(blob) for blob in ep["moment"]]
+    lo, hi = ep["start"] - ep["base"], ep["end"] - ep["base"]
+
+    # per-block overlap with the window [lo, hi)
+    spanned, spans, offset = [], [], 0
+    for block in blocks:
+        length = len(block["turn_idx"])
+        a, b = max(0, lo - offset), min(length, hi - offset)
+        if a < b:
+            spanned.append(block)
+            spans.append((a, b))
+        offset += length
+
+    def cat(key):
+        return _tree_cat_slice([b[key] for b in spanned], spans)
+
+    players_all = blocks[0]["players"]
+    players = players_all
+    if not cfg["turn_based_training"]:
+        # solo training: one random seat per draw (reference
+        # train.py:57-58 — same random.choice call per episode)
+        players = [random.choice(players)]
+    sel = [players_all.index(p) for p in players]
+
+    if cfg["turn_based_training"] and not cfg["observation"]:
+        # one acting seat per step: gather the turn player's data
+        # (P_in = 1)
+        turn_idx = cat("turn_idx")
+        obs = tree_map(lambda a: _take_turn(a, turn_idx), cat("obs"))
+        prob = _take_turn(cat("prob"), turn_idx)
+        act = _take_turn(cat("act"), turn_idx)
+        amask = _take_turn(cat("amask"), turn_idx)
+    else:
+        obs = tree_map(lambda a: a[:, sel], cat("obs"))
+        prob = cat("prob")[:, sel]
+        act = cat("act")[:, sel]
+        amask = cat("amask")[:, sel]
+
+    v = cat("value")[:, sel]
+    rew = cat("reward")[:, sel]
+    ret = cat("return")[:, sel]
     oc = np.array(
         [ep["outcome"][p] for p in players], np.float32
     ).reshape(1, len(players), 1)
 
-    emask = np.ones((len(moments), 1, 1), np.float32)
-    tmask = np.array(
-        [[[m["selected_prob"][p] is not None] for p in players] for m in moments],
-        np.float32,
-    )
-    omask = np.array(
-        [[[m["observation"][p] is not None] for p in players] for m in moments],
-        np.float32,
-    )
+    steps = hi - lo
+    emask = np.ones((steps, 1, 1), np.float32)
+    tmask = cat("tmask")[:, sel]
+    omask = cat("omask")[:, sel]
     progress = (
         np.arange(ep["start"], ep["end"], dtype=np.float32)[:, None] / ep["total"]
     )
@@ -184,9 +265,9 @@ def _episode_tensors(ep, cfg):
     # pad short slices to the static window; burn-in alignment keeps the
     # training start at index burn_in_steps
     batch_steps = cfg["burn_in_steps"] + cfg["forward_steps"]
-    if len(moments) < batch_steps:
+    if steps < batch_steps:
         pad_b = cfg["burn_in_steps"] - (ep["train_start"] - ep["start"])
-        pad_a = batch_steps - len(moments) - pad_b
+        pad_a = batch_steps - steps - pad_b
         obs = tree_map(lambda a: _pad_time(a, pad_b, pad_a), obs)
         prob = _pad_time(prob, pad_b, pad_a, 1.0)
         # after the terminal step the value bootstrap is the final outcome
