@@ -72,8 +72,22 @@ def _pad_time(arr, before, after, value=0.0):
 # ---------------------------------------------------------------------
 
 _COL_CACHE = OrderedDict()  # blob -> (columnar dict, nbytes)
-_COL_CACHE_MAX_BYTES = 512 * 1024 * 1024  # per batcher process
+# PER BATCHER PROCESS: total resident cache is this times num_batchers
+# (config key ``columnar_cache_mb`` adjusts it; see set_columnar_cache_mb)
+_COL_CACHE_MAX_BYTES = 512 * 1024 * 1024
 _col_cache_bytes = 0
+
+
+def set_columnar_cache_mb(mb):
+    """Resize this process's columnar cache cap (called by each batcher
+    child from its config; 0/None keeps the default)."""
+    global _COL_CACHE_MAX_BYTES, _col_cache_bytes
+    if not mb:
+        return
+    _COL_CACHE_MAX_BYTES = int(mb) * 1024 * 1024
+    while _col_cache_bytes > _COL_CACHE_MAX_BYTES and _COL_CACHE:
+        _, (_, freed) = _COL_CACHE.popitem(last=False)
+        _col_cache_bytes -= freed
 
 
 def _nbytes_tree(x):

@@ -92,6 +92,14 @@ class TrainConfig:
     metrics_path: str = ""
     # XLA profiler trace output dir; "" disables trace capture
     profile_dir: str = ""
+    # columnar decompression cache cap, MiB PER BATCHER PROCESS
+    # (total resident cache ~= this * num_batchers); 0 = default 512
+    columnar_cache_mb: int = 0
+    # checkpoint retention: keep the newest N epoch files (0 = keep
+    # all, the reference behavior) ...
+    checkpoint_keep_last: int = 0
+    # ... plus every K-th epoch regardless of age (0 = none)
+    checkpoint_keep_every: int = 0
 
     def __post_init__(self):
         if self.policy_target not in POLICY_TARGETS:
@@ -110,6 +118,10 @@ class TrainConfig:
                 "auto", "float32", "bfloat16", "uint8"):
             raise ValueError(
                 f"unknown transfer_dtype {self.transfer_dtype!r}")
+        for key in ("columnar_cache_mb", "checkpoint_keep_last",
+                    "checkpoint_keep_every"):
+            if getattr(self, key) < 0:
+                raise ValueError(f"{key} must be >= 0")
 
     # The reference floors the eval rate so at least ~n^0.85 of every
     # update window is evaluation (/root/reference/handyrl/train.py:415).

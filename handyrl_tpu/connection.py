@@ -85,20 +85,23 @@ def open_socket_connection(address: str, port: int, reuse=False):
     return FramedConnection(sock)
 
 
-def accept_socket_connections(port: int, timeout=None, maxsize=1024):
+def accept_socket_connections(port: int, timeout=None, backlog=128):
     """Generator of connections; yields None on accept timeout so the
-    caller's loop can check for shutdown."""
+    caller's loop can check for shutdown.
+
+    Accepts forever: workers are elastic and may churn indefinitely, so
+    there is deliberately NO lifetime accept cap — live-connection
+    bookkeeping belongs to the consumer (QueueCommunicator drops dead
+    peers).  ``backlog`` only bounds the kernel's pending-accept queue."""
     server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     server.bind(("", port))
-    server.listen(maxsize)
+    server.listen(backlog)
     server.settimeout(timeout)
-    cnt = 0
-    while cnt < maxsize:
+    while True:
         try:
             sock, _ = server.accept()
             yield FramedConnection(sock)
-            cnt += 1
         except socket.timeout:
             yield None
 

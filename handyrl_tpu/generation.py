@@ -214,8 +214,11 @@ class RolloutPool:
     a newer snapshot enters a slot mid-flight, the whole pool switches
     to it: the behavior probabilities recorded per step are whatever
     policy actually produced the action, so importance-sampling
-    corrections stay exact even though the episode's ``model_id`` label
-    is the epoch that scheduled it.
+    corrections stay exact.  Each finished episode records the epoch
+    that actually completed it (``final_model_epoch``) so stats
+    attribution stays truthful even for mixed-policy episodes; any
+    future league/mixed-snapshot scheduler must not assume the job's
+    ``model_id`` label describes every step.
 
     Recurrent nets keep a stacked hidden state of shape ``(K*P, ...)``;
     rows advance only for the seats that actually observed this step
@@ -231,6 +234,7 @@ class RolloutPool:
         self.K = len(self.envs)
         self.N = self.K * self.P
         self.model = None
+        self.model_epoch = -1       # epoch label of the installed model
         self.hidden = None
         self.slots = [None] * self.K
         self._free = list(range(self.K))
@@ -265,6 +269,7 @@ class RolloutPool:
         slot = _Slot(job, job["role"])
         neural = next(m for m in models.values() if m is not None)
         self._set_model(neural)
+        self.model_epoch = max(job["model_id"].values())
 
         if slot.mode == "e":
             import random as _random
@@ -391,9 +396,17 @@ class RolloutPool:
                 return ("episode", None)
             fill_discounted_returns(
                 slot.moments, env.players(), self.args["gamma"])
-            return ("episode", pack_episode(
+            episode = pack_episode(
                 slot.moments, env.outcome(), slot.job,
-                self.args["compress_steps"]))
+                self.args["compress_steps"])
+            # the pool may have swapped to a newer snapshot mid-episode
+            # (IS-exact — recorded probs are the acting policy's), so
+            # the honest generation-stats label is the epoch that
+            # actually finished the episode, not the one that scheduled
+            # it.  Consumers fall back to the job label when absent
+            # (sequential Generator episodes are single-policy).
+            episode["final_model_epoch"] = self.model_epoch
+            return ("episode", episode)
         if not payload_ok:
             print("None episode in evaluation!")
             return ("result", None)

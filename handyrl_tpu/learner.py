@@ -73,11 +73,23 @@ def train_state_path():
     return os.path.join(_models_dir(), "train_state.ckpt")
 
 
+def write_atomic(path, state):
+    """Pickle to tmp + rename so a crash mid-write can never corrupt a
+    file a restart (or a worker fetching a snapshot) will read."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(state, f)
+    os.replace(tmp, path)
+
+
 def _batch_worker(conn, bid, cfg):
     """Batcher child process: decompress + assemble numpy batches."""
     from .connection import force_cpu_jax
 
     force_cpu_jax()
+    from .batch import set_columnar_cache_mb
+
+    set_columnar_cache_mb(cfg.get("columnar_cache_mb"))
     print(f"started batcher {bid}")
     try:
         while True:
@@ -102,6 +114,7 @@ class Batcher:
         cfg = {k: args[k] for k in (
             "turn_based_training", "observation", "forward_steps",
             "burn_in_steps", "compress_steps", "lambda",
+            "columnar_cache_mb",
         ) if k in args}
         transfer = resolve_transfer_dtype(args)
         if transfer:
@@ -398,10 +411,7 @@ class Trainer:
             "data_cnt_ema": self.data_cnt_ema,
             "epoch": epoch,
         }
-        tmp = train_state_path() + ".tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump(state, f)
-        os.replace(tmp, train_state_path())
+        write_atomic(train_state_path(), state)
 
     def _default_mesh_cfg(self):
         """With no mesh configured on a multi-device host, default to
@@ -537,21 +547,23 @@ class Trainer:
 
     def run(self):
         print("waiting training")
-        while len(self.episodes) < self.args["minimum_episodes"]:
-            if self.shutdown_flag:
-                return
-            time.sleep(1)
-        if self.optimizer is not None:
-            self.batcher.run()
-            self.prefetcher = DevicePrefetcher(
-                self.batcher.batch,
-                depth=self.args.get("prefetch_batches", 2),
-                sharding=self.batch_sharding,
-                threads=self.args.get("transfer_threads", 2),
-                obs_float=self.compute_dtype,
-            )
-            print("started training")
         try:
+            # warmup wait lives inside try so the finally block owns
+            # trace.close() on every exit path, including warmup-abort
+            while len(self.episodes) < self.args["minimum_episodes"]:
+                if self.shutdown_flag:
+                    return
+                time.sleep(1)
+            if self.optimizer is not None:
+                self.batcher.run()
+                self.prefetcher = DevicePrefetcher(
+                    self.batcher.batch,
+                    depth=self.args.get("prefetch_batches", 2),
+                    sharding=self.batch_sharding,
+                    threads=self.args.get("transfer_threads", 2),
+                    obs_float=self.compute_dtype,
+                )
+                print("started training")
             while not self.shutdown_flag:
                 model = self.train()
                 if model is None:
@@ -653,8 +665,8 @@ class Learner:
 
         self.env = make_env(env_args)
         # guarantee at least ~update_episodes^0.85 eval games per epoch
-        floor = self.args["update_episodes"] ** -0.15
-        self.eval_rate = max(self.args["eval_rate"], floor)
+        # (single source of truth: TrainConfig.effective_eval_rate)
+        self.eval_rate = cfg.train_args.effective_eval_rate
         self.shutdown_flag = False
 
         self.model_epoch = self.args["restart_epoch"]
@@ -690,6 +702,28 @@ class Learner:
         return model
 
     # -- checkpointing ----------------------------------------------
+    def _prune_checkpoints(self):
+        """Retention: keep the newest ``checkpoint_keep_last`` epoch
+        files plus every ``checkpoint_keep_every``-th epoch (0 = keep
+        all) so week-long runs don't accumulate thousands of pickles.
+        The reference keeps everything (train.py:448-455).  Incremental:
+        only epochs newly crossing the retention boundary are removed
+        (one catch-up sweep on the first update after a restart)."""
+        keep_last = int(self.args.get("checkpoint_keep_last", 0) or 0)
+        if keep_last <= 0:
+            return
+        keep_every = int(self.args.get("checkpoint_keep_every", 0) or 0)
+        boundary = self.model_epoch - keep_last + 1  # prune below this
+        for epoch in range(getattr(self, "_pruned_below", 1), boundary):
+            if keep_every > 0 and epoch % keep_every == 0:
+                continue
+            try:
+                os.remove(model_path(epoch))
+            except OSError:
+                pass  # already pruned (or an epoch that never saved)
+        self._pruned_below = max(getattr(self, "_pruned_below", 1),
+                                 boundary)
+
     def update_model(self, model, steps):
         print("updated model(%d)" % steps)
         self.model_epoch += 1
@@ -697,19 +731,25 @@ class Learner:
         os.makedirs(_models_dir(), exist_ok=True)
         state = {"params": model.params, "steps": steps,
                  "epoch": self.model_epoch}
-        with open(model_path(self.model_epoch), "wb") as f:
-            pickle.dump(state, f)
-        with open(latest_model_path(), "wb") as f:
-            pickle.dump(state, f)
+        write_atomic(model_path(self.model_epoch), state)
+        write_atomic(latest_model_path(), state)
+        self._prune_checkpoints()
 
     # -- episode / result intake ------------------------------------
     def feed_episodes(self, episodes):
         kept = [e for e in episodes if e is not None]
         for episode in kept:
             job = episode["args"]
+            # trained seats credit the epoch that actually finished the
+            # episode (the pool may swap snapshots mid-flight; see
+            # RolloutPool); opponent seats keep their scheduled label
+            final = episode.get("final_model_epoch")
             for p in job["player"]:
+                label = job["model_id"][p]
+                if final is not None and label >= 0:
+                    label = final
                 stats = self.generation_stats.setdefault(
-                    job["model_id"][p], RunningScore())
+                    label, RunningScore())
                 stats.add(episode["outcome"][p])
         before = self.episodes_received
         self.episodes_received += len(kept)
@@ -830,7 +870,10 @@ class Learner:
             replies = handler(payload if batched else [payload])
             self.worker.send(conn, replies if batched else replies[0])
 
-            if self.episodes_received >= next_epoch_at:
+            # episodes drained from worker pools after shutdown still
+            # land in the buffer but must not start extra epochs
+            if (self.episodes_received >= next_epoch_at
+                    and not self.shutdown_flag):
                 next_epoch_at += self.args["update_episodes"]
                 self.update()
                 if 0 <= self.args["epochs"] <= self.model_epoch:

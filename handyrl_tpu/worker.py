@@ -177,12 +177,24 @@ class Worker:
             while pool.has_free_slot():
                 job = send_recv(self.conn, ("args", None))
                 if job is None:
-                    return  # learner is done; drop in-flight episodes
+                    # learner is done assigning; finish what's in
+                    # flight (the sequential path always ships its
+                    # current episode — so does the pool)
+                    self._drain_pool()
+                    return
                 if not pool.accepts(job):
                     self._run_job(job)
                     continue
                 for verb, payload in pool.assign(job, self._resolve(job)):
                     send_recv(self.conn, (verb, payload))
+            for verb, payload in pool.step():
+                send_recv(self.conn, (verb, payload))
+
+    def _drain_pool(self):
+        """Step the pool without assigning new jobs until every
+        in-flight episode finishes, shipping each one upstream."""
+        pool = self.pool
+        while any(slot is not None for slot in pool.slots):
             for verb, payload in pool.step():
                 send_recv(self.conn, (verb, payload))
 

@@ -1,0 +1,97 @@
+"""Comm-substrate unit tests: elastic churn and checkpoint hygiene.
+
+The reference's listeners accept at most ~1024 lifetime connections
+before going deaf; ours must survive unbounded worker churn
+(/root/reference/docs/large_scale_training.md scale claim)."""
+
+import os
+import pickle
+import socket
+import threading
+
+from handyrl_tpu.connection import (
+    accept_socket_connections,
+    open_socket_connection,
+)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_listener_survives_1500_connect_disconnect_cycles():
+    """Elastic churn far past the old 1024 lifetime-accept cap: every
+    cycle must still be served."""
+    port = _free_port()
+    served = []
+    stop = threading.Event()
+
+    def serve():
+        for conn in accept_socket_connections(port=port, timeout=0.2):
+            if stop.is_set():
+                return
+            if conn is None:
+                continue
+            try:
+                conn.send(len(served))
+                served.append(1)
+            finally:
+                conn.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+
+    # the listener thread binds lazily on its first accept iteration
+    import time
+
+    for _ in range(100):
+        try:
+            probe = open_socket_connection("127.0.0.1", port)
+            probe.recv()
+            probe.close()
+            break
+        except ConnectionRefusedError:
+            time.sleep(0.05)
+
+    cycles = 1500
+    got = 1  # the readiness probe was cycle 0
+    for i in range(cycles):
+        conn = open_socket_connection("127.0.0.1", port)
+        assert conn.recv() == got
+        got += 1
+        conn.close()
+    stop.set()
+    t.join(timeout=5)
+    assert got == cycles + 1
+
+
+def test_checkpoint_retention_and_atomicity(tmp_path, monkeypatch):
+    """keep-last-N pruning retains the newest N epochs plus every K-th,
+    and checkpoint writes leave no .tmp debris."""
+    monkeypatch.chdir(tmp_path)
+    from handyrl_tpu.learner import Learner, model_path
+
+    learner = Learner.__new__(Learner)  # no server/env needed
+    learner.args = {"checkpoint_keep_last": 3, "checkpoint_keep_every": 5}
+    learner.model_epoch = 0
+
+    class FakeModel:
+        params = {"w": 0}
+
+    for _ in range(12):
+        Learner.update_model(learner, FakeModel(), steps=learner.model_epoch)
+
+    kept = sorted(
+        int(f.split(".")[0]) for f in os.listdir("models")
+        if f[0].isdigit())
+    # newest 3 = {10, 11, 12}; every 5th = {5, 10}
+    assert kept == [5, 10, 11, 12]
+    assert not any(f.endswith(".tmp") for f in os.listdir("models"))
+    with open(model_path(12), "rb") as f:
+        assert pickle.load(f)["epoch"] == 12
+    with open(os.path.join("models", "latest.ckpt"), "rb") as f:
+        assert pickle.load(f)["epoch"] == 12

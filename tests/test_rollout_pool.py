@@ -65,7 +65,8 @@ def test_pool_wire_format_and_batch():
     pool, model, job, models = _make_pool("TicTacToe", TTT_CFG, k=4)
     episodes = _collect(pool, job, models, 6)
     for ep in episodes:
-        assert set(ep) == {"args", "steps", "outcome", "moment"}
+        assert set(ep) == {"args", "steps", "outcome", "moment",
+                           "final_model_epoch"}
         moments = [m for blob in ep["moment"]
                    for m in decompress_moments(
                        {"moment": [blob], "start": 0, "base": 0,
