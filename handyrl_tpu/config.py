@@ -75,6 +75,11 @@ class TrainConfig:
     lockstep_episodes: int = 16
     # device mesh shape for the learner, e.g. {"dp": 4}; empty = single chip
     mesh: Dict[str, int] = field(default_factory=dict)
+    # multi-host learner (one process per host over one global mesh);
+    # empty = single process.  Keys: coordinator_address ("host:port"
+    # of process 0), num_processes, process_id (all auto-detected on
+    # Cloud TPU pods — `distributed: {auto: true}` suffices there)
+    distributed: Dict[str, Any] = field(default_factory=dict)
     # number of device-resident batches to keep prefetched
     prefetch_batches: int = 2
     # background host->device transfer threads feeding the prefetch

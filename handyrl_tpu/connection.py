@@ -75,6 +75,15 @@ class FramedConnection:
 
 # -- TCP helpers --------------------------------------------------------
 
+def find_free_port() -> int:
+    """An OS-assigned free TCP port (tests, local multihost bring-up)."""
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    return port
+
+
 def open_socket_connection(address: str, port: int, reuse=False):
     sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     sock.setsockopt(

@@ -107,9 +107,12 @@ class Batcher:
     to child processes that decompress + assemble fixed-shape numpy
     batches (reference train.py:271-319)."""
 
-    def __init__(self, args, episodes):
+    def __init__(self, args, episodes, batch_size=None):
         self.args = args
         self.episodes = episodes
+        # multi-host: every process's batchers build only its shard of
+        # the global batch (batch_size = global / process_count)
+        self.batch_size = batch_size or args["batch_size"]
         # children only need the batch-geometry keys, not the env
         cfg = {k: args[k] for k in (
             "turn_based_training", "observation", "forward_steps",
@@ -127,7 +130,7 @@ class Batcher:
     def _selector(self):
         while True:
             yield [self.select_episode()
-                   for _ in range(self.args["batch_size"])]
+                   for _ in range(self.batch_size)]
 
     def run(self):
         self.executor.start()
@@ -218,6 +221,30 @@ def _packed_unpack(layout):
     return _unpack_cache[layout]
 
 
+def _stage_batch_multihost(batch, sharding, obs_float):
+    """Multi-process staging: this process's batch shard becomes its
+    slice of the global arrays.
+
+    Decode happens on the host (uint8 -> float; bf16 ships natively):
+    the single-host uint16-bitcast trick is a jitted computation, and a
+    global-array jit is a collective program launch that unsynchronized
+    prefetch threads must never issue.  See
+    parallel.multihost.global_batch_from_local.
+    """
+    from .parallel.multihost import global_batch_from_local
+
+    float_np = _BF16_NP if obs_float == "bfloat16" else np.float32
+
+    def decode(a):
+        if getattr(a, "dtype", None) == np.uint8:
+            return a.astype(float_np)
+        return a
+
+    batch = dict(batch)
+    batch["observation"] = jax.tree.map(decode, batch["observation"])
+    return global_batch_from_local(batch, sharding)
+
+
 def _stage_batch(batch, sharding, obs_float="bfloat16"):
     """``device_put`` a host batch in its compact wire format and
     restore compute dtypes on device.
@@ -238,6 +265,8 @@ def _stage_batch(batch, sharding, obs_float="bfloat16"):
         2 (packed + observation).  Exact: every small leaf is float32
         or a small-integer tensor that round-trips through f32.
     """
+    if jax.process_count() > 1:
+        return _stage_batch_multihost(batch, sharding, obs_float)
     if sharding is None:
         keys = sorted(k for k in batch if k != "observation")
         cols, layout = [], []
@@ -352,7 +381,17 @@ class Trainer:
         self.shutdown_flag = False
         self.failure = None
         self.update_queue = queue.Queue(maxsize=1)
-        self.batcher = Batcher(self.args, self.episodes)
+        # multi-host: this process is one controller of a global mesh;
+        # its batchers build 1/process_count of every global batch
+        self.multihost = jax.process_count() > 1
+        self.primary = jax.process_index() == 0
+        local_bs = None
+        if self.multihost:
+            from .parallel.multihost import local_batch_size
+
+            local_bs = local_batch_size(args["batch_size"])
+        self.batcher = Batcher(self.args, self.episodes,
+                               batch_size=local_bs)
         self.batch_sharding = None
         self.prefetcher = None
         self.timers = SectionTimers()
@@ -365,8 +404,22 @@ class Trainer:
             self.opt_state = self.optimizer.init(self.params)
             self.update_step = self._build_update_step()
             self._maybe_restore_train_state()
+            if self.multihost:
+                self._sync_initial_state()
         else:
             self.optimizer = None
+
+    def _sync_initial_state(self):
+        """Broadcast process 0's full train state so replicas provably
+        start identical — required when only process 0 could read a
+        restart checkpoint, and cheap insurance against any per-host
+        init drift.  One-time collective at startup."""
+        from .parallel.multihost import broadcast_train_state
+
+        self.params, self.opt_state, self.steps, self.data_cnt_ema = (
+            broadcast_train_state(
+                self.params, self.opt_state, self.steps,
+                self.data_cnt_ema))
 
     def _maybe_restore_train_state(self):
         """Resume optimizer state on restart (the reference checkpoints
@@ -443,6 +496,12 @@ class Trainer:
             # only auto-shard when the user left mesh unset; an explicit
             # all-ones mesh (e.g. {dp: 1}) forces the unsharded step
             mesh_cfg = self._default_mesh_cfg()
+        if self.multihost and not (
+                mesh_cfg and any(int(v) > 1 for v in mesh_cfg.values())):
+            raise ValueError(
+                "multi-host training requires a multi-device mesh: set "
+                "`mesh:` explicitly or make batch_size divisible by the "
+                "global device count")
         if mesh_cfg and any(int(v) > 1 for v in mesh_cfg.values()):
             from .parallel import (
                 MeshSpec,
@@ -475,14 +534,18 @@ class Trainer:
                 if self.failure is not None or self.shutdown_flag:
                     return None, self.steps
 
-    def train(self):
-        if self.optimizer is None:  # non-parametric model
-            time.sleep(0.1)
-            return self.model
+    def _do_update(self, batch):
+        with self.timers.section("update"):
+            self.params, self.opt_state, metrics = self.update_step(
+                self.params, self.opt_state, batch)
+        self.trace.tick()
+        self.steps += 1
+        return metrics
 
-        batch_cnt = 0
-        metric_acc = []
-
+    def _epoch_loop_local(self):
+        """Single-process epoch: train until the learner asks for the
+        snapshot (and at least one batch has landed)."""
+        batch_cnt, metric_acc = 0, []
         while batch_cnt == 0 or not self.update_flag:
             if self.shutdown_flag:
                 return None
@@ -491,14 +554,57 @@ class Trainer:
                     batch = self.prefetcher.get(timeout=0.3)
             except queue.Empty:
                 continue
-            with self.timers.section("update"):
-                self.params, self.opt_state, metrics = self.update_step(
-                    self.params, self.opt_state, batch)
-            self.trace.tick()
             # keep metrics on device; sync once per epoch
-            metric_acc.append(metrics)
+            metric_acc.append(self._do_update(batch))
             batch_cnt += 1
-            self.steps += 1
+        return batch_cnt, metric_acc
+
+    def _epoch_loop_multihost(self):
+        """Multi-process epoch: process 0 decides, everyone executes the
+        same step count.  Each iteration syncs one control word (STEP /
+        EPOCH_END / STOP) — the same collective doubles as the step
+        barrier, so every process's jitted-call sequence is identical
+        by construction (the SPMD contract)."""
+        from .parallel import multihost as mh
+
+        batch_cnt, metric_acc = 0, []
+        while True:
+            code = mh.STEP
+            if self.primary:
+                if self.shutdown_flag or self.failure is not None:
+                    code = mh.STOP
+                elif batch_cnt > 0 and self.update_flag:
+                    code = mh.EPOCH_END
+            code = mh.sync_epoch_code(code)
+            if code == mh.STOP:
+                self.shutdown_flag = True
+                return None
+            if code == mh.EPOCH_END:
+                return batch_cnt, metric_acc
+            # committed to one more global step: block until this
+            # process's shard arrives (peers are already waiting in
+            # the collective; a dead feed here stalls the job until
+            # the distributed runtime's heartbeat fails it)
+            while True:
+                try:
+                    with self.timers.section("batch_wait"):
+                        batch = self.prefetcher.get(timeout=1)
+                    break
+                except queue.Empty:
+                    continue
+            metric_acc.append(self._do_update(batch))
+            batch_cnt += 1
+
+    def train(self):
+        if self.optimizer is None:  # non-parametric model
+            time.sleep(0.1)
+            return self.model
+
+        result = (self._epoch_loop_multihost() if self.multihost
+                  else self._epoch_loop_local())
+        if result is None:
+            return None
+        batch_cnt, metric_acc = result
 
         data_cnt = sum(float(m["dcnt"]) for m in metric_acc)
         loss_sum = {}
@@ -527,23 +633,35 @@ class Trainer:
         for name, v in prof.items():
             self.last_metrics[f"profile_{name}_sec"] = v["sec"]
         self.epoch += 1
-        try:
-            os.makedirs(_models_dir(), exist_ok=True)
-            self.save_train_state(self.epoch)
-        except OSError:
-            pass
+        if self.primary:  # process 0 owns the (shared) checkpoint dir
+            try:
+                os.makedirs(_models_dir(), exist_ok=True)
+                self.save_train_state(self.epoch)
+            except OSError:
+                pass
         return snapshot
 
-    def shutdown(self):
-        """Stop the training thread (checked between batches).
+    def request_shutdown(self):
+        """Ask the training thread to stop (checked between batches and
+        broadcast to peers at the next control sync in multihost mode).
 
         The profiler trace is NOT closed here: ``trace`` belongs to the
         training thread (tick() runs there), so close() happens in
         ``run``'s finally block to avoid racing a tick mid-start."""
         self.shutdown_flag = True
+
+    def stop_feeds(self):
+        """Tear down the batch pipeline.  Call AFTER the training
+        thread has exited: a multihost step the control collective
+        already committed to still needs its batch, and starving it
+        would stall every peer process in the collective."""
         if self.prefetcher is not None:
             self.prefetcher.stop()
         self.batcher.shutdown()
+
+    def shutdown(self):
+        self.request_shutdown()
+        self.stop_feeds()
 
     def run(self):
         print("waiting training")
@@ -668,6 +786,11 @@ class Learner:
         # (single source of truth: TrainConfig.effective_eval_rate)
         self.eval_rate = cfg.train_args.effective_eval_rate
         self.shutdown_flag = False
+        # multi-host: every process runs a full learner (own actors,
+        # own replay, own shard of every global batch); process 0
+        # additionally owns checkpoints, metrics, and epoch decisions
+        self.multihost = jax.process_count() > 1
+        self.primary = jax.process_index() == 0
 
         self.model_epoch = self.args["restart_epoch"]
         self.model = self._initial_model(net)
@@ -728,6 +851,10 @@ class Learner:
         print("updated model(%d)" % steps)
         self.model_epoch += 1
         self.model = model
+        if not self.primary:
+            # replicas serve the in-memory snapshot to their own
+            # workers; only process 0 writes the checkpoint dir
+            return
         os.makedirs(_models_dir(), exist_ok=True)
         state = {"params": model.params, "steps": steps,
                  "epoch": self.model_epoch}
@@ -820,7 +947,7 @@ class Learner:
         self.update_model(model, steps)
         record["steps"] = steps
         record.update(getattr(self.trainer, "last_metrics", {}))
-        if self.metrics_path:
+        if self.metrics_path and self.primary:
             with open(self.metrics_path, "a") as f:
                 f.write(json.dumps(record) + "\n")
         self.replay.warned = False
@@ -857,22 +984,32 @@ class Learner:
             try:
                 conn, (verb, payload) = self.worker.recv(timeout=0.3)
             except queue.Empty:
-                continue
+                conn = None  # epoch checks below still run on idle
 
-            # gathers batch requests into lists; single requests get a
-            # single reply back
-            batched = isinstance(payload, list)
-            handler = handlers.get(verb)
-            if handler is None:
-                # unknown verb from a stray/mis-versioned client: shrug
-                self.worker.send(conn, [] if batched else None)
-                continue
-            replies = handler(payload if batched else [payload])
-            self.worker.send(conn, replies if batched else replies[0])
+            if conn is not None:
+                # gathers batch requests into lists; single requests
+                # get a single reply back
+                batched = isinstance(payload, list)
+                handler = handlers.get(verb)
+                if handler is None:
+                    # unknown verb from a stray client: shrug
+                    self.worker.send(conn, [] if batched else None)
+                    continue
+                replies = handler(payload if batched else [payload])
+                self.worker.send(
+                    conn, replies if batched else replies[0])
 
+            if self.multihost and not self.primary:
+                # replicas don't decide epochs: they follow the trainer,
+                # which follows process 0 through the control collective
+                if (self.trainer.epoch > self.model_epoch
+                        and not self.shutdown_flag):
+                    self.update()
+                if self.trainer.shutdown_flag:
+                    self.shutdown_flag = True
             # episodes drained from worker pools after shutdown still
             # land in the buffer but must not start extra epochs
-            if (self.episodes_received >= next_epoch_at
+            elif (self.episodes_received >= next_epoch_at
                     and not self.shutdown_flag):
                 next_epoch_at += self.args["update_episodes"]
                 self.update()
@@ -923,18 +1060,36 @@ class Learner:
             self.server()
         finally:
             # stop device work before interpreter teardown: a daemon
-            # thread mid-update during exit crashes the XLA runtime
-            self.trainer.shutdown()
+            # thread mid-update during exit crashes the XLA runtime.
+            # Feeds stop only after the thread exits — a committed
+            # multihost step still needs its batch (see stop_feeds)
+            self.trainer.request_shutdown()
             trainer_thread.join(timeout=30)
+            self.trainer.stop_feeds()
             self.worker.shutdown()
 
 
+def _maybe_init_distributed(args):
+    """Multi-host bring-up must precede any jax device use, so it runs
+    at the mode entry point, before envs or models touch the backend."""
+    dist_cfg = (args.get("train_args") or {}).get("distributed")
+    if dist_cfg:
+        from .parallel.multihost import init_distributed
+
+        init_distributed(dist_cfg)
+        print(f"distributed: process {jax.process_index()} of "
+              f"{jax.process_count()}, {jax.local_device_count()} local "
+              f"/ {jax.device_count()} global devices")
+
+
 def train_main(args):
+    _maybe_init_distributed(args)
     prepare_env(args["env_args"])
     learner = Learner(args=args)
     learner.run()
 
 
 def train_server_main(args):
+    _maybe_init_distributed(args)
     learner = Learner(args=args, remote=True)
     learner.run()

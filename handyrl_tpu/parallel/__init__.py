@@ -21,6 +21,13 @@ from .mesh import (
     replicated,
 )
 from .update import make_sharded_update_step
+from .multihost import (
+    init_distributed,
+    is_primary,
+    local_batch_size,
+    global_batch_from_local,
+    sync_epoch_code,
+)
 
 __all__ = [
     "MeshSpec",
@@ -29,4 +36,9 @@ __all__ = [
     "param_sharding",
     "replicated",
     "make_sharded_update_step",
+    "init_distributed",
+    "is_primary",
+    "local_batch_size",
+    "global_batch_from_local",
+    "sync_epoch_code",
 ]

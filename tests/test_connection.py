@@ -6,27 +6,19 @@ before going deaf; ours must survive unbounded worker churn
 
 import os
 import pickle
-import socket
 import threading
 
 from handyrl_tpu.connection import (
     accept_socket_connections,
+    find_free_port,
     open_socket_connection,
 )
-
-
-def _free_port():
-    s = socket.socket()
-    s.bind(("", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
 
 
 def test_listener_survives_1500_connect_disconnect_cycles():
     """Elastic churn far past the old 1024 lifetime-accept cap: every
     cycle must still be served."""
-    port = _free_port()
+    port = find_free_port()
     served = []
     stop = threading.Event()
 
@@ -78,6 +70,7 @@ def test_checkpoint_retention_and_atomicity(tmp_path, monkeypatch):
     learner = Learner.__new__(Learner)  # no server/env needed
     learner.args = {"checkpoint_keep_last": 3, "checkpoint_keep_every": 5}
     learner.model_epoch = 0
+    learner.primary = True
 
     class FakeModel:
         params = {"w": 0}

@@ -1,0 +1,112 @@
+"""Multi-host learner: two controller processes, one global mesh.
+
+Rehearses the TPU-pod execution model (one process per host) on one
+machine: each process owns 4 virtual CPU devices, runs a full learner
+(its own actors/replay/batchers feeding its shard of every global
+batch), gradients sync inside the jitted step, and process 0 alone
+writes checkpoints.  The capability the reference never had — its
+learner tops out at single-process ``nn.DataParallel``
+(/root/reference/handyrl/train.py:340-341)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from handyrl_tpu.connection import find_free_port
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHILD = r"""
+import sys
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+pid, port = int(sys.argv[1]), int(sys.argv[2])
+
+args = {
+    "env_args": {"env": "TicTacToe"},
+    "train_args": {
+        "turn_based_training": True,
+        "observation": False,
+        "gamma": 0.8,
+        "forward_steps": 4,
+        "burn_in_steps": 0,
+        "compress_steps": 4,
+        "entropy_regularization": 0.1,
+        "entropy_regularization_decay": 0.1,
+        "update_episodes": 10,
+        "batch_size": 8,          # global; 4 rows per process
+        "minimum_episodes": 8,
+        "maximum_episodes": 200,
+        "epochs": 1,
+        "num_batchers": 1,
+        "eval_rate": 0.1,
+        "worker": {"num_parallel": 1},
+        "lambda": 0.7,
+        "policy_target": "TD",
+        "value_target": "TD",
+        "seed": 3,
+        "lockstep_episodes": 4,
+        "mesh": {"dp": 8},
+        "distributed": {
+            "coordinator_address": "127.0.0.1:%d" % port,
+            "num_processes": 2,
+            "process_id": pid,
+        },
+    },
+    "worker_args": {"num_parallel": 1, "server_address": ""},
+}
+
+if __name__ == "__main__":  # spawn-safe: children re-import this file
+    from handyrl_tpu.learner import train_main
+
+    train_main(args)
+    print("CHILD %d DONE model_epoch ok" % pid)
+"""
+
+
+@pytest.mark.slow
+def test_two_process_learner(tmp_path):
+    port = find_free_port()
+    script = tmp_path / "child.py"
+    script.write_text(CHILD)
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(pid), str(port)],
+            cwd=tmp_path, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for pid, proc in enumerate(procs):
+            out, _ = proc.communicate(timeout=600)
+            outs.append(out)
+            assert proc.returncode == 0, (
+                f"proc {pid} failed:\n"
+                + "\n".join(out.splitlines()[-20:]))
+    finally:
+        for proc in procs:  # no orphans blocked in the collective
+            if proc.poll() is None:
+                proc.kill()
+
+    losses = []
+    for pid, out in enumerate(outs):
+        assert "updated model(1)" in out, f"proc {pid} never updated"
+        assert f"CHILD {pid} DONE" in out
+        losses.extend(
+            line for line in out.splitlines()
+            if line.startswith("loss = "))
+    # the replicated loss metric must agree across controllers
+    assert len(set(losses)) == 1, losses
+    # process 0 alone owns the checkpoint dir
+    assert os.path.exists(tmp_path / "models" / "1.ckpt")
+    assert os.path.exists(tmp_path / "models" / "train_state.ckpt")
