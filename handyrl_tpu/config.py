@@ -100,6 +100,17 @@ class TrainConfig:
     # columnar decompression cache cap, MiB PER BATCHER PROCESS
     # (total resident cache ~= this * num_batchers); 0 = default 512
     columnar_cache_mb: int = 0
+    # device-resident replay: episodes live in HBM and every batch is
+    # built on device by one jitted gather (no host assembly, no
+    # per-step transfer).  auto = on for single-process learners
+    # (multi-host keeps the host path); on | off force it
+    device_replay: str = "auto"
+    # HBM budget for the device replay ring, MiB (per device when the
+    # ring is replicated over a mesh)
+    device_replay_mb: int = 4096
+    # explicit ring capacity in episodes; 0 = maximum_episodes,
+    # clamped to the byte budget either way
+    device_replay_episodes: int = 0
     # checkpoint retention: keep the newest N epoch files (0 = keep
     # all, the reference behavior) ...
     checkpoint_keep_last: int = 0
@@ -124,9 +135,13 @@ class TrainConfig:
             raise ValueError(
                 f"unknown transfer_dtype {self.transfer_dtype!r}")
         for key in ("columnar_cache_mb", "checkpoint_keep_last",
-                    "checkpoint_keep_every"):
+                    "checkpoint_keep_every", "device_replay_mb",
+                    "device_replay_episodes"):
             if getattr(self, key) < 0:
                 raise ValueError(f"{key} must be >= 0")
+        if self.device_replay not in ("auto", "on", "off"):
+            raise ValueError(
+                f"unknown device_replay {self.device_replay!r}")
 
     # The reference floors the eval rate so at least ~n^0.85 of every
     # update window is evaluation (/root/reference/handyrl/train.py:415).

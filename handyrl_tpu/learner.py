@@ -390,9 +390,9 @@ class Trainer:
             from .parallel.multihost import local_batch_size
 
             local_bs = local_batch_size(args["batch_size"])
-        self.batcher = Batcher(self.args, self.episodes,
-                               batch_size=local_bs)
         self.batch_sharding = None
+        self.train_mesh = None
+        self.train_fsdp = False
         self.prefetcher = None
         self.timers = SectionTimers()
         self.trace = TraceWindow(self.args.get("profile_dir") or "")
@@ -408,6 +408,55 @@ class Trainer:
                 self._sync_initial_state()
         else:
             self.optimizer = None
+
+        self.device_replay = self._maybe_device_replay()
+        self._replay_step = None
+        if self.device_replay is not None:
+            from .staging import make_replay_update_step
+
+            # ONE jitted program per step: gather + loss + grad + Adam
+            self._replay_step = make_replay_update_step(
+                self.device_replay, self.model, self.loss_cfg,
+                self.optimizer, self.compute_dtype,
+                mesh=self.train_mesh, params=self.params,
+                fsdp=self.train_fsdp)
+        # the host batcher farm exists only when the device-resident
+        # path is off: skipping it frees host cores for actors
+        self.batcher = None
+        if self.optimizer is not None and self.device_replay is None:
+            self.batcher = Batcher(self.args, self.episodes,
+                                   batch_size=local_bs)
+
+    def _maybe_device_replay(self):
+        """Build the HBM-resident replay (staging.DeviceReplay) when
+        configured.  auto = on for single-process learners; multi-host
+        keeps the host batcher path (per-process rings + global-array
+        assembly is future work)."""
+        mode = self.args.get("device_replay", "auto") or "auto"
+        if self.optimizer is None or mode == "off":
+            return None
+        if self.multihost:
+            if mode == "on":
+                raise ValueError(
+                    "device_replay: on is not yet supported with "
+                    "multi-host training; set device_replay: off")
+            return None
+        from .staging import DeviceReplay
+
+        cfg = {
+            "turn_based_training": self.args["turn_based_training"],
+            "observation": self.args.get("observation", False),
+            "forward_steps": self.args["forward_steps"],
+            "burn_in_steps": self.args.get("burn_in_steps", 0),
+            "transfer_dtype": resolve_transfer_dtype(self.args),
+            "compute_dtype": self.compute_dtype,
+        }
+        capacity = (self.args.get("device_replay_episodes", 0)
+                    or self.args["maximum_episodes"])
+        max_bytes = (self.args.get("device_replay_mb", 4096)
+                     or 4096) << 20
+        return DeviceReplay(cfg, capacity, max_bytes,
+                            mesh=self.train_mesh)
 
     def _sync_initial_state(self):
         """Broadcast process 0's full train state so replicas provably
@@ -491,18 +540,27 @@ class Trainer:
     def _build_update_step(self):
         dtype = self.compute_dtype
         print(f"compute dtype: {dtype}")
-        mesh_cfg = self.args.get("mesh") or {}
-        if not mesh_cfg:
-            # only auto-shard when the user left mesh unset; an explicit
+        mesh_cfg = dict(self.args.get("mesh") or {})
+        axes_cfg = {k: v for k, v in mesh_cfg.items() if k != "fsdp"}
+        if not axes_cfg:
+            # only auto-shard when the user left the mesh AXES unset
+            # (a bare {fsdp: true} still engages auto-dp); an explicit
             # all-ones mesh (e.g. {dp: 1}) forces the unsharded step
-            mesh_cfg = self._default_mesh_cfg()
-        if self.multihost and not (
-                mesh_cfg and any(int(v) > 1 for v in mesh_cfg.values())):
+            default = self._default_mesh_cfg()
+            if default:
+                mesh_cfg = {**default,
+                            "fsdp": mesh_cfg.get("fsdp", False)}
+            elif mesh_cfg.get("fsdp"):
+                print("WARNING: mesh {fsdp: true} ignored — no "
+                      "multi-device dp axis available")
+        engaged = any(int(v) > 1 for k, v in mesh_cfg.items()
+                      if k != "fsdp")
+        if self.multihost and not engaged:
             raise ValueError(
                 "multi-host training requires a multi-device mesh: set "
                 "`mesh:` explicitly or make batch_size divisible by the "
                 "global device count")
-        if mesh_cfg and any(int(v) > 1 for v in mesh_cfg.values()):
+        if engaged:
             from .parallel import (
                 MeshSpec,
                 batch_sharding,
@@ -512,10 +570,13 @@ class Trainer:
 
             spec = MeshSpec.from_config(mesh_cfg)
             mesh = make_mesh(spec)
+            self.train_mesh = mesh
+            self.train_fsdp = spec.fsdp
             self.batch_sharding = batch_sharding(mesh)
             return make_sharded_update_step(
                 self.model, self.loss_cfg, self.optimizer, mesh,
                 self.params, shard_time=spec.sp > 1, compute_dtype=dtype,
+                fsdp=spec.fsdp,
             )
         return make_update_step(
             self.model, self.loss_cfg, self.optimizer, compute_dtype=dtype)
@@ -556,6 +617,34 @@ class Trainer:
                 continue
             # keep metrics on device; sync once per epoch
             metric_acc.append(self._do_update(batch))
+            batch_cnt += 1
+        return batch_cnt, metric_acc
+
+    def _epoch_loop_device(self):
+        """Device-replay epoch: gather + update run as ONE jitted
+        program per step; the host only drains newly arrived episodes
+        into the ring (bounded per step) and draws index vectors."""
+        import jax.numpy as jnp
+
+        replay = self.device_replay
+        batch_size = self.args["batch_size"]
+        batch_cnt, metric_acc = 0, []
+        while batch_cnt == 0 or not self.update_flag:
+            if self.shutdown_flag:
+                return None
+            with self.timers.section("ingest"):
+                replay.ingest(max_episodes=8)
+            with self.timers.section("batch_wait"):
+                slots, tstarts, seats = replay.draw_indices(batch_size)
+            with self.timers.section("update"):
+                (self.params, self.opt_state,
+                 metrics) = self._replay_step(
+                    self.params, self.opt_state, replay.buffers,
+                    jnp.asarray(slots), jnp.asarray(tstarts),
+                    jnp.asarray(seats))
+            self.trace.tick()
+            self.steps += 1
+            metric_acc.append(metrics)
             batch_cnt += 1
         return batch_cnt, metric_acc
 
@@ -600,8 +689,12 @@ class Trainer:
             time.sleep(0.1)
             return self.model
 
-        result = (self._epoch_loop_multihost() if self.multihost
-                  else self._epoch_loop_local())
+        if self.multihost:
+            result = self._epoch_loop_multihost()
+        elif self.device_replay is not None:
+            result = self._epoch_loop_device()
+        else:
+            result = self._epoch_loop_local()
         if result is None:
             return None
         batch_cnt, metric_acc = result
@@ -632,6 +725,11 @@ class Trainer:
         self.last_metrics = {k: l / data_cnt for k, l in loss_sum.items()}
         for name, v in prof.items():
             self.last_metrics[f"profile_{name}_sec"] = v["sec"]
+        if self.device_replay is not None:
+            self.last_metrics["replay_episodes"] = \
+                self.device_replay.episodes_seen
+            self.last_metrics["replay_dropped"] = \
+                self.device_replay.dropped
         self.epoch += 1
         if self.primary:  # process 0 owns the (shared) checkpoint dir
             try:
@@ -657,7 +755,8 @@ class Trainer:
         would stall every peer process in the collective."""
         if self.prefetcher is not None:
             self.prefetcher.stop()
-        self.batcher.shutdown()
+        if self.batcher is not None:
+            self.batcher.shutdown()
 
     def shutdown(self):
         self.request_shutdown()
@@ -668,20 +767,39 @@ class Trainer:
         try:
             # warmup wait lives inside try so the finally block owns
             # trace.close() on every exit path, including warmup-abort
-            while len(self.episodes) < self.args["minimum_episodes"]:
-                if self.shutdown_flag:
-                    return
-                time.sleep(1)
-            if self.optimizer is not None:
-                self.batcher.run()
-                self.prefetcher = DevicePrefetcher(
-                    self.batcher.batch,
-                    depth=self.args.get("prefetch_batches", 2),
-                    sharding=self.batch_sharding,
-                    threads=self.args.get("transfer_threads", 2),
-                    obs_float=self.compute_dtype,
-                )
+            if self.device_replay is not None:
+                # warm the ring itself: episodes stream into HBM as
+                # they arrive, so training starts with a full ring.
+                # A ring smaller than minimum_episodes (explicit config
+                # or the byte clamp) must still start once it is full.
+                replay = self.device_replay
+                while replay.size < self.args["minimum_episodes"]:
+                    if self.shutdown_flag:
+                        return
+                    replay.ingest()
+                    if replay.size and replay.size >= replay.capacity:
+                        print(f"device replay ring ({replay.capacity})"
+                              f" is smaller than minimum_episodes "
+                              f"({self.args['minimum_episodes']}): "
+                              f"starting with a full ring")
+                        break
+                    time.sleep(0.05)
                 print("started training")
+            else:
+                while len(self.episodes) < self.args["minimum_episodes"]:
+                    if self.shutdown_flag:
+                        return
+                    time.sleep(1)
+                if self.optimizer is not None:
+                    self.batcher.run()
+                    self.prefetcher = DevicePrefetcher(
+                        self.batcher.batch,
+                        depth=self.args.get("prefetch_batches", 2),
+                        sharding=self.batch_sharding,
+                        threads=self.args.get("transfer_threads", 2),
+                        obs_float=self.compute_dtype,
+                    )
+                    print("started training")
             while not self.shutdown_flag:
                 model = self.train()
                 if model is None:
@@ -883,7 +1001,13 @@ class Learner:
         for mark in range(before // 100 + 1,
                           self.episodes_received // 100 + 1):
             print(mark * 100, end=" ", flush=True)
-        self.replay.extend(kept)
+        if self.trainer.device_replay is not None:
+            # HBM ring is the only replay store: retaining a second
+            # full copy in the host deque would double replay memory
+            # for a buffer nothing reads
+            self.trainer.device_replay.offer(kept)
+        else:
+            self.replay.extend(kept)
 
     def feed_results(self, results):
         for result in results:

@@ -3,14 +3,20 @@
 The reference's only device parallelism is single-process
 ``nn.DataParallel`` (/root/reference/handyrl/train.py:340-341).  Here the
 learner scales over a ``jax.sharding.Mesh`` instead: the batch is
-sharded over the ``dp`` axis, parameters are replicated (or sharded over
-``tp``/``fsdp`` by rule), and XLA inserts the gradient all-reduce over
-ICI — no hand-written collectives in the update step.
+sharded over the ``dp`` axis, parameters are replicated or sharded by
+rule (``tp`` output features; ``fsdp: true`` additionally distributes
+params + optimizer state over ``dp``, ZeRO-style), and XLA inserts the
+collectives — gradient all-reduce, weight all-gather, reduce-scatter —
+over ICI.  No hand-written collectives in the update step.
 
 Axes (any subset may be size 1):
   dp   — data parallel: batch dim of every batch tensor
   tp   — tensor parallel: output features of large dense/conv kernels
   sp   — sequence parallel: the time axis of long-sequence batches
+plus the ``fsdp`` rule toggle (shards state over ``dp``, not a new axis).
+
+Multi-host: see ``parallel.multihost`` — one controller process per
+host over a single global mesh.
 """
 
 from .mesh import (

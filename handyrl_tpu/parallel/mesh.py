@@ -24,19 +24,27 @@ class MeshSpec:
     Axis sizes of 1 are kept in the mesh (so sharding specs never need
     to special-case a missing axis); total size must divide the device
     count.
+
+    ``fsdp`` is a RULE toggle, not an axis: with it set, parameters and
+    optimizer state additionally shard over the existing ``dp`` axis
+    (ZeRO-style fully-sharded data parallelism) — XLA inserts the
+    weight all-gathers and gradient reduce-scatters.
     """
 
     dp: int = 1
     sp: int = 1
     tp: int = 1
+    fsdp: bool = False
 
     @classmethod
     def from_config(cls, mesh_cfg: Optional[Dict[str, int]]) -> "MeshSpec":
         mesh_cfg = dict(mesh_cfg or {})
+        fsdp = bool(mesh_cfg.pop("fsdp", False))
         unknown = set(mesh_cfg) - set(AXES)
         if unknown:
             raise ValueError(f"unknown mesh axes: {sorted(unknown)}")
-        return cls(**{a: int(mesh_cfg.get(a, 1)) for a in AXES})
+        return cls(fsdp=fsdp,
+                   **{a: int(mesh_cfg.get(a, 1)) for a in AXES})
 
     @property
     def size(self) -> int:
@@ -98,18 +106,47 @@ def _tp_spec_for(path: Tuple[str, ...], shape: Tuple[int, ...],
     return P(*([None] * (len(shape) - 1) + ["tp"]))
 
 
-def param_sharding(mesh: Mesh, params, min_tp_dim: int = 128):
+def _fsdp_spec_for(shape: Tuple[int, ...], dp_size: int,
+                   taken: P, min_fsdp_size: int) -> P:
+    """Shard one dim of a large tensor over ``dp`` (ZeRO-style).
+
+    Picks the LAST dim divisible by ``dp`` that isn't already taken by
+    ``tp``; small tensors stay replicated — sharding a bias saves
+    nothing and costs an all-gather.
+    """
+    if dp_size <= 1 or not shape:
+        return taken
+    if int(np.prod(shape)) < min_fsdp_size:
+        return taken
+    spec = list(taken) + [None] * (len(shape) - len(taken))
+    for axis in range(len(shape) - 1, -1, -1):
+        if spec[axis] is None and shape[axis] % dp_size == 0 \
+                and shape[axis] >= dp_size:
+            spec[axis] = "dp"
+            return P(*spec)
+    return taken
+
+
+def param_sharding(mesh: Mesh, params, min_tp_dim: int = 128,
+                   fsdp: bool = False, min_fsdp_size: int = 4096):
     """NamedShardings for a params pytree.
 
     Default policy: replicate everything unless the mesh has a real
-    ``tp`` axis, in which case wide kernels shard their output features.
+    ``tp`` axis, in which case wide kernels shard their output
+    features.  With ``fsdp``, large tensors additionally shard one dim
+    over ``dp`` — parameters and (structurally, via
+    ``opt_state_sharding``) Adam moments are then fully distributed,
+    cutting per-device state memory ~dp-fold.
     """
     tp_size = mesh.shape["tp"]
+    dp_size = mesh.shape["dp"]
 
     def spec(path, leaf):
         names = tuple(getattr(p, "key", str(p)) for p in path)
-        return NamedSharding(
-            mesh, _tp_spec_for(names, np.shape(leaf), tp_size, min_tp_dim)
-        )
+        shape = np.shape(leaf)
+        part = _tp_spec_for(names, shape, tp_size, min_tp_dim)
+        if fsdp:
+            part = _fsdp_spec_for(shape, dp_size, part, min_fsdp_size)
+        return NamedSharding(mesh, part)
 
     return jax.tree_util.tree_map_with_path(spec, params)

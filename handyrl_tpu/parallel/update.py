@@ -39,11 +39,14 @@ def make_sharded_update_step(model, cfg: LossConfig,
                              optimizer: optax.GradientTransformation,
                              mesh, params,
                              shard_time: bool = False,
-                             compute_dtype: str = "float32") -> Callable:
+                             compute_dtype: str = "float32",
+                             fsdp: bool = False) -> Callable:
     """Build the jitted SPMD ``update_step`` for a mesh.
 
     ``params`` is only inspected for its pytree structure/shapes to
     compute shardings; pass the live params at call time as usual.
+    With ``fsdp``, params + optimizer state shard over ``dp`` (ZeRO);
+    XLA inserts the weight all-gathers / grad reduce-scatters.
     """
     core = make_update_core(model, cfg, optimizer, compute_dtype)
 
@@ -67,7 +70,7 @@ def make_sharded_update_step(model, cfg: LossConfig,
     else:
         update_step = core
 
-    p_shard = param_sharding(mesh, params)
+    p_shard = param_sharding(mesh, params, fsdp=fsdp)
     b_shard = batch_sharding(mesh)
     rep = replicated(mesh)
     o_shard = opt_state_sharding(optimizer, params, p_shard, rep)

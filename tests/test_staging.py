@@ -1,0 +1,199 @@
+"""DeviceReplay gather must reproduce make_batch draw for draw.
+
+The device-resident staging path replaces host batch assembly
+entirely, so its jitted gather must produce the same batch the host
+path would for identical (episode, window, seat) draws — masks,
+padding, value bootstrap, progress, everything."""
+
+import random
+
+import numpy as np
+import pytest
+
+FWD = 8
+
+
+def _make_episodes(env_name, cfg, count, seed=7):
+    from handyrl_tpu.environment import make_env
+    from handyrl_tpu.generation import Generator
+    from handyrl_tpu.models import RandomModel, TPUModel
+
+    random.seed(seed)
+    env = make_env({"env": env_name})
+    env.reset()
+    model = TPUModel(env.net())
+    obs0 = env.observation(env.players()[0])
+    model.init_params(obs0, seed=seed)
+    rollout = RandomModel(model, obs0)
+    gen = Generator(env, cfg)
+    players = env.players()
+    job = {"player": players, "model_id": {p: 1 for p in players}}
+    episodes = []
+    while len(episodes) < count:
+        ep = gen.generate({p: rollout for p in players}, job)
+        if ep is not None:
+            episodes.append(ep)
+    return episodes, players
+
+
+def _host_batch(episodes, draws, cfg, players, monkeypatch):
+    """The host-path batch for explicit (ep_idx, train_start, seat)."""
+    from handyrl_tpu import batch as batch_mod
+
+    sels, seats = [], []
+    for ep_idx, train_start, seat in draws:
+        ep = episodes[ep_idx]
+        st = max(0, train_start - cfg["burn_in_steps"])
+        ed = min(train_start + cfg["forward_steps"], ep["steps"])
+        cmp = cfg["compress_steps"]
+        st_block, ed_block = st // cmp, (ed - 1) // cmp + 1
+        sels.append({
+            "args": ep["args"], "outcome": ep["outcome"],
+            "moment": ep["moment"][st_block:ed_block],
+            "base": st_block * cmp,
+            "start": st, "end": ed, "train_start": train_start,
+            "total": ep["steps"],
+        })
+        seats.append(players[seat])
+    # pin make_batch's per-episode random seat to our draw
+    seat_iter = iter(seats)
+    monkeypatch.setattr(
+        batch_mod.random, "choice", lambda seq: next(seat_iter))
+    return batch_mod.make_batch(sels, cfg)
+
+
+def _device_batch(episodes, draws, cfg):
+    import jax.numpy as jnp
+
+    from handyrl_tpu.staging import DeviceReplay, _decompress_episode
+
+    replay = DeviceReplay(cfg, capacity=len(episodes) + 2,
+                          max_bytes=1 << 30)
+    for ep in episodes:
+        replay._append(_decompress_episode(ep))
+    slots = jnp.asarray([d[0] for d in draws], jnp.int32)
+    tstarts = jnp.asarray([d[1] for d in draws], jnp.int32)
+    seats = jnp.asarray([d[2] for d in draws], jnp.int32)
+    return replay._sample_fn(replay.buffers, slots, tstarts, seats)
+
+
+def _draws(episodes, cfg, n, players, seed):
+    rng = random.Random(seed)
+    out = []
+    for _ in range(n):
+        idx = rng.randrange(len(episodes))
+        cands = 1 + max(0, episodes[idx]["steps"] - cfg["forward_steps"])
+        out.append((idx, rng.randrange(cands),
+                    rng.randrange(len(players))))
+    return out
+
+
+def _assert_batches_equal(host, dev, obs_wire):
+    import jax
+
+    host_obs = host.pop("observation")
+    dev_obs = dev.pop("observation")
+    for h, d in zip(jax.tree.leaves(host_obs), jax.tree.leaves(dev_obs)):
+        # host wire leaves are bf16/uint8; device output is compute
+        # dtype — compare in float32 (both conversions are exact)
+        np.testing.assert_array_equal(
+            np.asarray(h, np.float32), np.asarray(d, np.float32),
+            err_msg="observation")
+    for key in host:
+        np.testing.assert_array_equal(
+            np.asarray(host[key], np.float32),
+            np.asarray(dev[key], np.float32), err_msg=key)
+        assert host[key].shape == dev[key].shape, key
+
+
+CFG_BASE = {
+    "observation": False,
+    "gamma": 0.8,
+    "forward_steps": FWD,
+    "burn_in_steps": 0,
+    "compress_steps": 4,
+    "lambda": 0.7,
+    "transfer_dtype": "bfloat16",
+    "compute_dtype": "bfloat16",
+}
+
+
+@pytest.mark.parametrize("env_name,turn_based,burn_in", [
+    ("TicTacToe", True, 0),        # turn mode
+    ("TicTacToe", True, 3),        # turn mode + burn-in alignment
+    ("HungryGeese", False, 0),     # seat mode (flagship)
+    ("Geister", True, 4),          # turn mode, long RNN episodes
+])
+def test_device_gather_matches_make_batch(
+        env_name, turn_based, burn_in, monkeypatch):
+    cfg = dict(CFG_BASE, turn_based_training=turn_based,
+               burn_in_steps=burn_in)
+    episodes, players = _make_episodes(env_name, cfg, count=6)
+    draws = _draws(episodes, cfg, n=12, players=players, seed=13)
+    host = _host_batch(episodes, draws, cfg, players, monkeypatch)
+    dev = _device_batch(episodes, draws, cfg)
+    assert set(host) == set(dev)
+    _assert_batches_equal(host, dev, "bfloat16")
+
+
+def test_device_gather_uint8_storage(monkeypatch):
+    """Binary-plane envs can store observations quarter-width."""
+    cfg = dict(CFG_BASE, turn_based_training=True,
+               transfer_dtype="uint8")
+    episodes, players = _make_episodes("TicTacToe", cfg, count=4)
+    draws = _draws(episodes, cfg, n=8, players=players, seed=5)
+    host = _host_batch(episodes, draws, cfg, players, monkeypatch)
+    dev = _device_batch(episodes, draws, cfg)
+    _assert_batches_equal(host, dev, "uint8")
+
+
+def test_ring_eviction_and_growth():
+    """FIFO eviction past capacity; T_max growth re-lays the ring."""
+    import jax.numpy as jnp
+
+    from handyrl_tpu.staging import DeviceReplay, _decompress_episode
+
+    cfg = dict(CFG_BASE, turn_based_training=True)
+    episodes, _ = _make_episodes("Geister", cfg, count=5)
+    episodes.sort(key=lambda e: e["steps"])
+    replay = DeviceReplay(cfg, capacity=3, max_bytes=1 << 30,
+                          max_steps_hint=4)  # force growth
+    for ep in episodes:
+        replay._append(_decompress_episode(ep))
+    assert replay.size == 3
+    assert replay.episodes_seen == 5
+    assert replay.t_max >= max(e["steps"] for e in episodes)
+    # surviving slots are the 3 newest episodes
+    kept = sorted(int(x) for x in replay.ep_len[:3])
+    expect = sorted(e["steps"] for e in episodes[-3:])
+    assert kept == expect
+    import jax
+
+    batch = replay.sample(4)
+    for leaf in jax.tree.leaves(batch["observation"]):
+        assert leaf.shape[0] == 4
+    assert bool(jnp.all(jnp.isfinite(batch["selected_prob"])))
+
+
+def test_growth_respects_byte_budget():
+    """When wider slots no longer fit the budget, growth shrinks the
+    ring, keeping the newest episodes."""
+    from handyrl_tpu.staging import DeviceReplay, _decompress_episode
+
+    cfg = dict(CFG_BASE, turn_based_training=True)
+    episodes, _ = _make_episodes("Geister", cfg, count=5)
+    episodes.sort(key=lambda e: e["steps"])
+    short = _decompress_episode(episodes[0])
+    replay = DeviceReplay(cfg, capacity=400, max_bytes=1 << 30,
+                          max_steps_hint=episodes[0]["steps"])
+    replay._append(short)
+    # shrink the budget so doubling T_max must cost ring capacity
+    per_step = replay._per_step_bytes
+    # ~300 slot-widths at the OLD t_max: after doubling, only ~150 fit
+    replay.max_bytes = per_step * replay.t_max * 300
+    for ep in episodes[1:]:
+        replay._append(_decompress_episode(ep))
+    assert replay.capacity < 400
+    assert replay.size == min(5, replay.capacity)
+    batch = replay.sample(4)
+    assert batch["action"].shape[0] == 4
