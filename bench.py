@@ -565,6 +565,88 @@ def intake_child(num_parallel=32):
     os._exit(0)  # gathers exit on EOF; skip the non-daemonic joins
 
 
+def _ceiling_flooder(conn, episode, block):
+    """Pre-canned episode uploads as fast as the server will take them
+    (the gather protocol: batched list + one ack per message)."""
+    msg = ("episode", [episode] * block)
+    try:
+        while True:
+            conn.send(msg)
+            conn.recv()
+    except (BrokenPipeError, EOFError, OSError):
+        pass
+
+
+def intake_ceiling_child(num_flooders=3, block=16, window=15.0):
+    """Learner server-loop capacity with ZERO actor cost: flooder
+    processes replay one pre-canned TicTacToe episode in gather-sized
+    blocks; the parent drains them through the production
+    QueueCommunicator.  Separates "actors are the intake limit" from
+    "the server thread / pickle loop is the ceiling" (VERDICT r3 #7)."""
+    import queue
+    import random
+
+    from handyrl_tpu.connection import (
+        QueueCommunicator,
+        force_cpu_jax,
+        open_multiprocessing_connections,
+    )
+
+    force_cpu_jax()
+
+    from handyrl_tpu.environment import make_env
+    from handyrl_tpu.generation import Generator
+    from handyrl_tpu.models import RandomModel, TPUModel
+
+    random.seed(0)
+    env = make_env({"env": "TicTacToe"})
+    env.reset()
+    model = TPUModel(env.net())
+    obs0 = env.observation(env.players()[0])
+    model.init_params(obs0, seed=0)
+    gen = Generator(env, {
+        "turn_based_training": True, "observation": False,
+        "gamma": 0.8, "compress_steps": 4,
+    })
+    players = env.players()
+    job = {"player": players, "model_id": {p: 0 for p in players}}
+    rollout = RandomModel(model, obs0)
+    episode = None
+    while episode is None:
+        episode = gen.generate({p: rollout for p in players}, job)
+
+    conns = open_multiprocessing_connections(
+        num_flooders, _ceiling_flooder, lambda i: (episode, block))
+    comm = QueueCommunicator(conns)
+
+    count = 0
+    t0 = time.perf_counter()
+    measure_from = None
+    measured = 0
+    while True:
+        now = time.perf_counter()
+        if measure_from is not None and now - measure_from > window:
+            break
+        if now - t0 > 120:
+            break
+        try:
+            conn, (verb, payload) = comm.recv(timeout=0.3)
+        except queue.Empty:
+            continue
+        count += len(payload)
+        comm.send(conn, [None] * len(payload))
+        if measure_from is None and now - t0 > 3.0:
+            measure_from = now
+            measured = count
+    dt = time.perf_counter() - measure_from if measure_from else 1.0
+    print(json.dumps({
+        "intake_ceiling_eps_per_sec": round((count - measured) / dt, 1),
+        "intake_ceiling_flooders": num_flooders,
+    }))
+    sys.stdout.flush()
+    os._exit(0)
+
+
 def _run_child(flag, timeout=1200, extra=()):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
@@ -679,6 +761,14 @@ def main():
             extras[f"intake_error_w{n}"] = result.get(
                 "intake_error", "child_failed")
     extras["intake_scaling_by_workers"] = intake_scaling
+    # server-loop capacity with zero actor cost: names the bottleneck
+    extras.update(_run_child("--intake-ceiling-child", timeout=300))
+    ceiling = extras.get("intake_ceiling_eps_per_sec")
+    measured = extras.get("intake_episodes_per_sec")
+    if ceiling and measured:
+        extras["intake_bottleneck"] = (
+            "actors (server has headroom)" if ceiling > 2 * measured
+            else "server loop")
     ref_actor = baseline.get("actor_env_steps_per_sec_ttt")
     if ref_actor and extras.get("actor_env_steps_per_sec_ttt"):
         extras["reference_actor_env_steps_per_sec_ttt"] = ref_actor
@@ -707,5 +797,7 @@ if __name__ == "__main__":
     elif "--intake-child" in sys.argv:
         tail = [a for a in sys.argv[2:] if a.isdigit()]
         intake_child(int(tail[0]) if tail else 32)
+    elif "--intake-ceiling-child" in sys.argv:
+        intake_ceiling_child()
     else:
         main()

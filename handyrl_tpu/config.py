@@ -100,6 +100,11 @@ class TrainConfig:
     # columnar decompression cache cap, MiB PER BATCHER PROCESS
     # (total resident cache ~= this * num_batchers); 0 = default 512
     columnar_cache_mb: int = 0
+    # cap update steps per epoch; 0 = unlimited (train as fast as the
+    # feed allows, the reference behavior).  A fast learner otherwise
+    # replays the same window thousands of times per epoch and starves
+    # co-located actors of host CPU (single-process learners only)
+    updates_per_epoch: int = 0
     # device-resident replay: episodes live in HBM and every batch is
     # built on device by one jitted gather (no host assembly, no
     # per-step transfer).  auto = on for single-process learners
@@ -136,7 +141,7 @@ class TrainConfig:
                 f"unknown transfer_dtype {self.transfer_dtype!r}")
         for key in ("columnar_cache_mb", "checkpoint_keep_last",
                     "checkpoint_keep_every", "device_replay_mb",
-                    "device_replay_episodes"):
+                    "device_replay_episodes", "updates_per_epoch"):
             if getattr(self, key) < 0:
                 raise ValueError(f"{key} must be >= 0")
         if self.device_replay not in ("auto", "on", "off"):

@@ -377,10 +377,18 @@ def network_match_acception(n, env_args, num_agents, port):
 # ---------------------------------------------------------------------
 
 def load_model(model_path, env):
-    """Load a saved checkpoint (.ckpt pickle or exported .npz) into a
-    TPUModel for evaluation."""
+    """Load a saved checkpoint (.ckpt pickle, exported .npz, or an
+    ``.onnx`` artifact run by the bundled numpy ONNX runtime) into an
+    evaluation model."""
     import pickle
 
+    if model_path.endswith(".onnx"):
+        # same capability as the reference's onnxruntime path
+        # (/root/reference/handyrl/evaluation.py:287-365,356-365):
+        # third-party or exported graphs play through --eval
+        from .interop.onnx_run import OnnxModel
+
+        return OnnxModel(model_path)
     model = TPUModel(env.net())
     if model_path.endswith(".npz"):
         import numpy as np

@@ -1,0 +1,14 @@
+"""Framework-free deployment interop.
+
+ONNX without the onnx/onnxruntime packages: a protobuf codec for the
+ONNX schema (onnx_proto), a numpy graph interpreter that lets
+``--eval`` run ``.onnx`` artifacts (onnx_run), and a jaxpr -> ONNX
+exporter for the bundled flax nets (onnx_export).  Capability parity
+with /root/reference/handyrl/evaluation.py:287-365 and
+/root/reference/scripts/make_onnx_model.py.
+"""
+
+from .onnx_run import OnnxModel
+from .onnx_export import export_onnx
+
+__all__ = ["OnnxModel", "export_onnx"]

@@ -1,0 +1,398 @@
+"""Export flax policy nets to ``.onnx`` by translating their jaxpr.
+
+Capability parity with the reference's
+``scripts/make_onnx_model.py`` (torch.onnx.export of the trained net):
+the exported artifact runs the policy OUTSIDE the framework — Kaggle
+kernels, onnxruntime servers, or this repo's own numpy runner
+(onnx_run.py, used by ``--eval model.onnx``).
+
+TPU-native twist: there is no tracer to write — jaxpr IS the traced
+graph.  ``jax.make_jaxpr`` flattens the net (params close over as
+consts -> ONNX initializers; the DRC recurrence unrolls into pure
+conv/elementwise ops with hidden state as explicit graph I/O, so no
+ONNX LSTM op is needed), and each primitive maps to standard ONNX
+ops.  Convolutions are emitted NCHW with the kernel constant-folded to
+OIHW, so the file is conventional for third-party runtimes.
+
+Exports are fixed-batch (default 1 — the actor-side inference shape,
+same path the reference's OnnxModel uses for evaluation).
+"""
+
+import numpy as np
+
+from .onnx_proto import (
+    ATTR_FLOAT,
+    ATTR_INT,
+    ATTR_INTS,
+    ATTR_STRING,
+    ATTR_TENSOR,
+    DT_BOOL,
+    DT_FLOAT,
+    DT_INT32,
+    DT_INT64,
+    encode,
+)
+
+_NP_TO_DT = {
+    np.dtype(np.float32): DT_FLOAT,
+    np.dtype(np.int32): DT_INT32,
+    np.dtype(np.int64): DT_INT64,
+    np.dtype(np.bool_): DT_BOOL,
+}
+
+
+def numpy_to_tensor(arr: np.ndarray, name: str) -> dict:
+    arr = np.ascontiguousarray(arr)
+    if arr.dtype not in _NP_TO_DT:
+        arr = arr.astype(np.float32)
+    return {
+        "name": name,
+        "dims": list(arr.shape),
+        "data_type": _NP_TO_DT[arr.dtype],
+        "raw_data": arr.tobytes(),
+    }
+
+
+def _attr(name, value):
+    if isinstance(value, bool) or isinstance(value, (int, np.integer)):
+        return {"name": name, "type": ATTR_INT, "i": int(value)}
+    if isinstance(value, float):
+        return {"name": name, "type": ATTR_FLOAT, "f": value}
+    if isinstance(value, str):
+        return {"name": name, "type": ATTR_STRING, "s": value.encode()}
+    if isinstance(value, np.ndarray):
+        return {"name": name, "type": ATTR_TENSOR,
+                "t": numpy_to_tensor(value, name)}
+    if isinstance(value, (list, tuple)):
+        return {"name": name, "type": ATTR_INTS,
+                "ints": [int(v) for v in value]}
+    raise TypeError(f"attribute {name}: {type(value)}")
+
+
+def _value_info(name, shape, elem=DT_FLOAT):
+    return {"name": name, "type": {"tensor_type": {
+        "elem_type": elem,
+        "shape": {"dim": [{"dim_value": int(d)} for d in shape]},
+    }}}
+
+
+class _Builder:
+    """Accumulates nodes/initializers while walking a jaxpr."""
+
+    def __init__(self):
+        self.nodes = []
+        self.initializers = []
+        self.env = {}          # jaxpr Var -> tensor name
+        self.folded = {}       # jaxpr Var -> numpy const (param leaves)
+        self.counter = 0
+
+    def fresh(self, hint="t"):
+        self.counter += 1
+        return f"{hint}_{self.counter}"
+
+    def const(self, arr, hint="const"):
+        name = self.fresh(hint)
+        self.initializers.append(
+            numpy_to_tensor(np.asarray(arr), name))
+        return name
+
+    def node(self, op, inputs, n_out=1, out=None, **attrs):
+        outputs = out if out is not None else [
+            self.fresh(op.lower()) for _ in range(n_out)]
+        self.nodes.append({
+            "op_type": op,
+            "input": list(inputs),
+            "output": list(outputs),
+            "attribute": [_attr(k, v) for k, v in attrs.items()
+                          if v is not None],
+        })
+        return outputs[0] if len(outputs) == 1 else outputs
+
+    def read(self, atom):
+        """jaxpr atom -> tensor name (Literals become initializers)."""
+        import jax
+
+        if isinstance(atom, jax.extend.core.Literal):
+            return self.const(np.asarray(atom.val), "lit")
+        return self.env[atom]
+
+
+_UNARY = {
+    "neg": "Neg", "exp": "Exp", "log": "Log", "tanh": "Tanh",
+    "logistic": "Sigmoid", "sqrt": "Sqrt", "abs": "Abs",
+    "stop_gradient": "Identity", "copy": "Identity",
+    "floor": "Floor", "is_finite": "Identity",
+}
+_BINARY = {
+    "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div",
+    "max": "Max", "min": "Min", "pow": "Pow",
+    "ge": "GreaterOrEqual", "gt": "Greater",
+    "le": "LessOrEqual", "lt": "Less", "eq": "Equal",
+    "and": "And", "or": "Or", "xor": "Xor",
+}
+
+
+def _emit_conv(b, eqn, lhs, rhs_atom):
+    p = eqn.params
+    dn = p["dimension_numbers"]
+    lhs_spec, rhs_spec, out_spec = dn.lhs_spec, dn.rhs_spec, dn.out_spec
+    if any(d != 1 for d in p["lhs_dilation"]):
+        raise NotImplementedError("transposed conv not supported")
+    # operand -> NCHW
+    perm_in = (lhs_spec[0], lhs_spec[1]) + tuple(lhs_spec[2:])
+    x = b.node("Transpose", [lhs], perm=perm_in)
+    # kernel -> OIHW; params are consts, so fold the transpose
+    import jax
+
+    kperm = (rhs_spec[0], rhs_spec[1]) + tuple(rhs_spec[2:])
+    if isinstance(rhs_atom, jax.extend.core.Literal):
+        w = b.const(np.transpose(np.asarray(rhs_atom.val), kperm), "w")
+    elif rhs_atom in b.folded:
+        w = b.const(np.transpose(b.folded[rhs_atom], kperm), "w")
+    else:
+        w = b.node("Transpose", [b.env[rhs_atom]], perm=kperm)
+    pads = list(p["padding"])  # [(lo, hi)] per spatial dim
+    conv = b.node(
+        "Conv", [x, w],
+        strides=list(p["window_strides"]),
+        dilations=list(p["rhs_dilation"]),
+        group=int(p["feature_group_count"]),
+        pads=[lo for lo, _ in pads] + [hi for _, hi in pads],
+    )
+    # NCHW -> original out layout: out_spec says where (N, C, *s) go
+    inv = np.argsort((out_spec[0], out_spec[1]) + tuple(out_spec[2:]))
+    return b.node("Transpose", [conv], perm=[int(i) for i in inv])
+
+
+def _emit_dot(b, eqn, names):
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    lhs_av, rhs_av = (v.aval for v in eqn.invars)
+    if lb or rb:
+        raise NotImplementedError("batched dot_general")
+    if (len(lc) != 1 or len(rc) != 1
+            or lc[0] != lhs_av.ndim - 1 or rc[0] != 0):
+        raise NotImplementedError(
+            f"dot_general layout {eqn.params['dimension_numbers']}")
+    return b.node("MatMul", names)
+
+
+def _emit_broadcast(b, eqn, x):
+    shape = [int(d) for d in eqn.params["shape"]]
+    bdims = eqn.params["broadcast_dimensions"]
+    in_shape = eqn.invars[0].aval.shape
+    staged = [1] * len(shape)
+    for i, d in enumerate(bdims):
+        staged[d] = int(in_shape[i])
+    r = b.node("Reshape", [x, b.const(np.asarray(staged, np.int64))])
+    return b.node("Expand", [r, b.const(np.asarray(shape, np.int64))])
+
+
+def _emit_eqn(b, eqn):
+    import jax
+
+    p = eqn.primitive.name
+    names = [b.read(v) for v in eqn.invars]
+
+    # call-like primitives: inline the inner jaxpr
+    inner = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")
+    if inner is not None:
+        if hasattr(inner, "jaxpr"):  # ClosedJaxpr
+            const_names = [b.const(np.asarray(c), "c")
+                           for c in inner.consts]
+            inner = inner.jaxpr
+        else:
+            const_names = []
+        for var, cname in zip(inner.constvars, const_names):
+            b.env[var] = cname
+        for var, name in zip(inner.invars, names):
+            b.env[var] = name
+        for ieqn in inner.eqns:
+            _emit_eqn(b, ieqn)
+        for outer_v, inner_v in zip(eqn.outvars, inner.outvars):
+            b.env[outer_v] = b.read(inner_v)
+        return
+
+    if p in _UNARY:
+        out = b.node(_UNARY[p], names)
+    elif p in _BINARY:
+        out = b.node(_BINARY[p], names)
+    elif p == "rsqrt":
+        out = b.node("Reciprocal", [b.node("Sqrt", names)])
+    elif p == "square":
+        out = b.node("Mul", [names[0], names[0]])
+    elif p == "cbrt":
+        out = b.node("Pow", [names[0], b.const(np.float32(1 / 3))])
+    elif p == "integer_pow":
+        exp = b.const(np.float32(eqn.params["y"]))
+        out = b.node("Pow", [names[0], exp])
+    elif p == "conv_general_dilated":
+        out = _emit_conv(b, eqn, names[0], eqn.invars[1])
+    elif p == "dot_general":
+        out = _emit_dot(b, eqn, names)
+    elif p == "reduce_sum":
+        # axes-as-input since opset 13
+        axes = b.const(np.asarray(eqn.params["axes"], np.int64))
+        out = b.node("ReduceSum", [names[0], axes], keepdims=0)
+    elif p in ("reduce_max", "reduce_min"):
+        # axes stay an ATTRIBUTE until opset 18; we declare 17
+        op = "ReduceMax" if p == "reduce_max" else "ReduceMin"
+        out = b.node(op, [names[0]],
+                     axes=list(eqn.params["axes"]), keepdims=0)
+    elif p == "broadcast_in_dim":
+        out = _emit_broadcast(b, eqn, names[0])
+    elif p == "reshape":
+        shape = b.const(np.asarray(eqn.params["new_sizes"], np.int64))
+        out = b.node("Reshape", [names[0], shape])
+    elif p == "squeeze":
+        shape = b.const(
+            np.asarray(eqn.outvars[0].aval.shape, np.int64))
+        out = b.node("Reshape", [names[0], shape])
+    elif p == "expand_dims":
+        shape = b.const(
+            np.asarray(eqn.outvars[0].aval.shape, np.int64))
+        out = b.node("Reshape", [names[0], shape])
+    elif p == "transpose":
+        out = b.node("Transpose", names,
+                     perm=list(eqn.params["permutation"]))
+    elif p == "concatenate":
+        out = b.node("Concat", names,
+                     axis=int(eqn.params["dimension"]))
+    elif p == "slice":
+        if eqn.params.get("strides") is None:
+            strides = [1] * len(eqn.params["start_indices"])
+        else:
+            strides = list(eqn.params["strides"])
+        out = b.node("Slice", [
+            names[0],
+            b.const(np.asarray(eqn.params["start_indices"], np.int64)),
+            b.const(np.asarray(eqn.params["limit_indices"], np.int64)),
+            b.const(np.arange(len(strides), dtype=np.int64)),
+            b.const(np.asarray(strides, np.int64)),
+        ])
+    elif p == "pad":
+        cfg = eqn.params["padding_config"]
+        if any(i != 0 for _, _, i in cfg) or \
+                any(lo < 0 or hi < 0 for lo, hi, _ in cfg):
+            raise NotImplementedError(
+                "interior/negative padding not supported")
+        pads = [lo for lo, _, _ in cfg] + [hi for _, hi, _ in cfg]
+        out = b.node("Pad", [
+            names[0],
+            b.const(np.asarray(pads, np.int64)),
+            names[1],  # pad value operand
+        ], mode="constant")
+    elif p == "convert_element_type":
+        dt = _NP_TO_DT.get(np.dtype(eqn.params["new_dtype"]), DT_FLOAT)
+        out = b.node("Cast", [names[0]], to=dt)
+    elif p == "select_n":
+        if len(names) != 3:
+            raise NotImplementedError("select_n with >2 cases")
+        # select_n(pred, on_false, on_true)
+        out = b.node("Where", [names[0], names[2], names[1]])
+    elif p == "split":
+        sizes = list(eqn.params["sizes"])
+        outs = b.node("Split", [
+            names[0], b.const(np.asarray(sizes, np.int64))],
+            n_out=len(sizes), axis=int(eqn.params["axis"]))
+        outs = outs if isinstance(outs, list) else [outs]
+        for var, name in zip(eqn.outvars, outs):
+            b.env[var] = name
+        return
+    elif p == "iota":
+        # static: fold to an initializer
+        shape = eqn.params["shape"]
+        dim = eqn.params["dimension"]
+        arr = np.broadcast_to(
+            np.arange(shape[dim]).reshape(
+                [-1 if i == dim else 1 for i in range(len(shape))]),
+            shape).astype(np.float32)
+        out = b.const(arr, "iota")
+    else:
+        raise NotImplementedError(
+            f"jaxpr primitive {p!r} has no ONNX mapping "
+            f"(eqn: {eqn})")
+    b.env[eqn.outvars[0]] = out
+
+
+def export_onnx(model, obs_example, path, batch_size=1):
+    """Write ``model`` (a TPUModel) to ``path`` as ONNX.
+
+    ``obs_example`` is one unbatched environment observation (defines
+    input shapes).  Hidden state (if the net is recurrent) becomes
+    explicit ``hidden_i`` inputs / ``hidden_out_i`` outputs, matching
+    the reference's OnnxModel discovery protocol.
+    """
+    import jax
+
+    params = model.params
+    module = model.module
+    obs_b = jax.tree.map(
+        lambda a: np.broadcast_to(
+            np.asarray(a, np.float32), (batch_size,) + np.shape(a)
+        ).copy(),
+        obs_example)
+    hidden = model.init_hidden([batch_size])
+
+    def fn(obs, hidden):
+        out = dict(module.apply({"params": params}, obs, hidden))
+        hid = out.pop("hidden", None)
+        return out, hid
+
+    closed = jax.make_jaxpr(fn)(obs_b, hidden)
+    out_shape = jax.eval_shape(fn, obs_b, hidden)
+    out_leaves_named = []
+    out_dict, out_hidden = out_shape
+    # names for flat outputs: dict keys in jax's flatten order (sorted)
+    for key in sorted(out_dict):
+        n = len(jax.tree.leaves(out_dict[key]))
+        if n == 1:
+            out_leaves_named.append(key)
+        else:
+            out_leaves_named.extend(f"{key}_{i}" for i in range(n))
+    n_hidden_out = len(jax.tree.leaves(out_hidden))
+    out_leaves_named.extend(
+        f"hidden_out_{i}" for i in range(n_hidden_out))
+
+    b = _Builder()
+    jaxpr = closed.jaxpr
+    for var, const in zip(jaxpr.constvars, closed.consts):
+        arr = np.asarray(const)
+        b.env[var] = b.const(arr, "param")
+        b.folded[var] = arr  # lets conv fold kernel transposes
+
+    obs_leaves = jax.tree.leaves(obs_b)
+    hidden_leaves = jax.tree.leaves(hidden)
+    input_infos = []
+    for i, (var, leaf) in enumerate(zip(
+            jaxpr.invars, obs_leaves + hidden_leaves)):
+        name = (f"input_{i}" if i < len(obs_leaves)
+                else f"hidden_{i - len(obs_leaves)}")
+        b.env[var] = name
+        input_infos.append(_value_info(name, np.shape(leaf)))
+
+    for eqn in jaxpr.eqns:
+        _emit_eqn(b, eqn)
+
+    output_infos = []
+    for name, var in zip(out_leaves_named, jaxpr.outvars):
+        src = b.read(var)
+        b.node("Identity", [src], out=[name])
+        output_infos.append(_value_info(name, var.aval.shape))
+
+    graph = {
+        "name": "handyrl_tpu",
+        "node": b.nodes,
+        "initializer": b.initializers,
+        "input": input_infos,
+        "output": output_infos,
+    }
+    onnx_model = {
+        "ir_version": 8,
+        "producer_name": "handyrl-tpu",
+        "producer_version": "1.0",
+        "opset_import": [{"domain": "", "version": 17}],
+        "graph": graph,
+    }
+    with open(path, "wb") as f:
+        f.write(encode(onnx_model, "Model"))
+    return path

@@ -606,10 +606,14 @@ class Trainer:
     def _epoch_loop_local(self):
         """Single-process epoch: train until the learner asks for the
         snapshot (and at least one batch has landed)."""
+        cap = int(self.args.get("updates_per_epoch", 0) or 0)
         batch_cnt, metric_acc = 0, []
         while batch_cnt == 0 or not self.update_flag:
             if self.shutdown_flag:
                 return None
+            if cap and batch_cnt >= cap:
+                time.sleep(0.01)
+                continue
             try:
                 with self.timers.section("batch_wait"):
                     batch = self.prefetcher.get(timeout=0.3)
@@ -628,12 +632,20 @@ class Trainer:
 
         replay = self.device_replay
         batch_size = self.args["batch_size"]
+        cap = int(self.args.get("updates_per_epoch", 0) or 0)
         batch_cnt, metric_acc = 0, []
         while batch_cnt == 0 or not self.update_flag:
             if self.shutdown_flag:
                 return None
             with self.timers.section("ingest"):
+                # drain arrivals even when idling at the cap, so the
+                # pending queue can't overflow and shed episodes
                 replay.ingest(max_episodes=8)
+            if cap and batch_cnt >= cap:
+                # epoch budget spent: idle until the learner asks for
+                # the snapshot, releasing host CPU to the actors
+                time.sleep(0.01)
+                continue
             with self.timers.section("batch_wait"):
                 slots, tstarts, seats = replay.draw_indices(batch_size)
             with self.timers.section("update"):
@@ -1067,6 +1079,13 @@ class Learner:
 
         model, steps = self.trainer.update()
         if model is None:
+            # keep serving the last snapshot, but say so LOUDLY: a run
+            # that silently reports the initial net's win rate for
+            # hours is worse than one that crashes (r4 lesson)
+            if self.trainer.failure is not None:
+                print("WARNING: trainer thread failed "
+                      f"({self.trainer.failure!r}); serving the last "
+                      "model unchanged")
             model = self.model
         self.update_model(model, steps)
         record["steps"] = steps

@@ -27,10 +27,16 @@ host" to "transfer ~3 KB of indices", and the per-episode upload is
 amortized over every draw of that episode (recency-biased sampling
 draws each episode many times per epoch).
 
-Storage layout: per-step channels are flat ``(CAP * T_max, ...)``
-arrays (slot-major time), so a window fetch is ONE gather with indices
+Storage layout: per-step channels are TWO-dimensional
+``(CAP * T_max, flat_features)`` arrays (slot-major time, trailing
+dims flattened), so a window fetch is ONE gather with indices
 ``slot * T_max + t`` — never materializing a ``(B, T_max, ...)``
-intermediate, which at the flagship geometry would be ~0.5 GB.
+intermediate — and, critically, the persistent ring pads to the TPU's
+(8, 128) tile with ~1% overhead.  Keeping logical trailing dims (e.g.
+``(N, P, 6, 6, 7)``) instead would tile-pad the ring up to ~24x and
+OOM the device (observed on Geister: a 2 GB ring became a 47 GB
+allocation).  The gather reshapes windows back to logical shapes
+in-jit, where they are transient activations XLA lays out freely.
 Per-slot channels (outcome, lengths) are ``(CAP, ...)``.
 
 Concurrency contract: appends and samples MUST run on one thread (the
@@ -245,22 +251,37 @@ class DeviceReplay:
         A = col["amask"].shape[-1]
         flat = self.capacity * self.t_max
         z = jnp.zeros
+        # logical per-step shapes; stored flattened to 2D (see module
+        # docstring: TPU tile padding on small trailing dims)
+        self.obs_shapes = [leaf.shape[1:]
+                           for leaf in jax.tree.leaves(col["obs"])]
+        self.obs_treedef = jax.tree.structure(col["obs"])
+        self.shapes = {
+            "prob": (P, 1), "act": (P, 1), "amask": (P, A),
+            "value": (P, 1), "reward": (P, 1), "return": (P, 1),
+            "tmask": (P, 1), "omask": (P, 1), "turn_idx": (),
+        }
+
+        def flat2d(shape, dtype):
+            width = int(np.prod(shape)) if shape else 1
+            return z((flat, width), dtype)
+
         self.buffers = {
             "obs": tree_map(
-                lambda a: z((flat, P) + a.shape[2:],
-                            self.obs_store
-                            if np.issubdtype(a.dtype, np.floating)
-                            else a.dtype),
+                lambda a: flat2d(a.shape[1:],
+                                 self.obs_store
+                                 if np.issubdtype(a.dtype, np.floating)
+                                 else a.dtype),
                 col["obs"]),
-            "prob": z((flat, P, 1), jnp.float32),
-            "act": z((flat, P, 1), jnp.int32),
-            "amask": z((flat, P, A), jnp.bool_),
-            "value": z((flat, P, 1), jnp.float32),
-            "reward": z((flat, P, 1), jnp.float32),
-            "return": z((flat, P, 1), jnp.float32),
-            "tmask": z((flat, P, 1), jnp.bool_),
-            "omask": z((flat, P, 1), jnp.bool_),
-            "turn_idx": z((flat,), jnp.int32),
+            "prob": flat2d((P, 1), jnp.float32),
+            "act": flat2d((P, 1), jnp.int32),
+            "amask": flat2d((P, A), jnp.bool_),
+            "value": flat2d((P, 1), jnp.float32),
+            "reward": flat2d((P, 1), jnp.float32),
+            "return": flat2d((P, 1), jnp.float32),
+            "tmask": flat2d((P, 1), jnp.bool_),
+            "omask": flat2d((P, 1), jnp.bool_),
+            "turn_idx": flat2d((), jnp.int32),
             "outcome": z((self.capacity, P, 1), jnp.float32),
             "ep_len": z((self.capacity,), jnp.int32),
             "ep_total": z((self.capacity,), jnp.int32),
@@ -301,10 +322,11 @@ class DeviceReplay:
         pad = self.t_max - T
 
         def padt(a, value=0):
+            a = np.ascontiguousarray(a).reshape(T, -1)  # 2D storage
             if pad == 0:
                 return a
-            width = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
-            return np.pad(a, width, constant_values=value)
+            return np.pad(a, [(0, pad), (0, 0)],
+                          constant_values=value)
 
         def obs_store(a):
             if not np.issubdtype(a.dtype, np.floating):
@@ -450,23 +472,27 @@ class DeviceReplay:
         gi = jnp.clip(g, 0, t_max - 1)
         flat_idx = slots[:, None] * t_max + gi                     # (B,T)
 
-        def fetch(buf):                  # (CAP*T_max, ...) -> (B,T,...)
-            return buf[flat_idx]
+        def fetch(buf, shape):
+            # 2D ring row -> logical (B, T, *shape) window
+            return buf[flat_idx].reshape(flat_idx.shape + tuple(shape))
 
         def mask_t(x, pad_value, m=valid):
             shape = m.shape + (1,) * (x.ndim - 2)
             return jnp.where(m.reshape(shape), x, pad_value)
 
-        turn = fetch(buffers["turn_idx"])                # (B,T)
-        obs = tree_map(fetch, buffers["obs"])            # (B,T,P,...)
-        prob = fetch(buffers["prob"])
-        act = fetch(buffers["act"])
-        amask = fetch(buffers["amask"])
-        value = fetch(buffers["value"])
-        reward = fetch(buffers["reward"])
-        ret = fetch(buffers["return"])
-        tmask = fetch(buffers["tmask"])
-        omask = fetch(buffers["omask"])
+        turn = fetch(buffers["turn_idx"], ())            # (B,T)
+        obs = jax.tree.unflatten(self.obs_treedef, [
+            fetch(buf, shape) for buf, shape in zip(
+                jax.tree.leaves(buffers["obs"]), self.obs_shapes)
+        ])                                               # (B,T,P,...)
+        prob = fetch(buffers["prob"], self.shapes["prob"])
+        act = fetch(buffers["act"], self.shapes["act"])
+        amask = fetch(buffers["amask"], self.shapes["amask"])
+        value = fetch(buffers["value"], self.shapes["value"])
+        reward = fetch(buffers["reward"], self.shapes["reward"])
+        ret = fetch(buffers["return"], self.shapes["return"])
+        tmask = fetch(buffers["tmask"], self.shapes["tmask"])
+        omask = fetch(buffers["omask"], self.shapes["omask"])
         outcome = buffers["outcome"][slots]              # (B,P,1)
 
         def select_players(x, idx):

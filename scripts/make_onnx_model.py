@@ -1,0 +1,53 @@
+"""Export a trained checkpoint to ``.onnx``.
+
+Role parity with /root/reference/scripts/make_onnx_model.py (torch
+``.pth`` -> ``.onnx`` for Kaggle kernels / onnxruntime servers).  Here
+the net's jaxpr is translated to ONNX ops directly
+(handyrl_tpu.interop.onnx_export) — recurrent nets unroll with hidden
+state as explicit ``hidden_i`` inputs / ``hidden_out_i`` outputs, the
+same discovery protocol the reference's OnnxModel uses.
+
+The artifact round-trips through this repo's own numpy runner:
+  python main.py --eval models/latest.onnx 100 4
+
+Usage: python scripts/make_onnx_model.py [model.ckpt] [out.onnx]
+Reads the env from ./config.yaml (like the reference script).
+"""
+
+import os
+import pickle
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import yaml
+
+
+def main():
+    ckpt = sys.argv[1] if len(sys.argv) > 1 else "models/latest.ckpt"
+    out = sys.argv[2] if len(sys.argv) > 2 else (
+        os.path.splitext(ckpt)[0] + ".onnx")
+
+    with open("config.yaml") as f:
+        env_args = yaml.safe_load(f)["env_args"]
+
+    from handyrl_tpu.environment import make_env
+    from handyrl_tpu.interop.onnx_export import export_onnx
+    from handyrl_tpu.models import TPUModel
+
+    env = make_env(env_args)
+    env.reset()
+    model = TPUModel(env.net())
+    with open(ckpt, "rb") as f:
+        state = pickle.load(f)
+    model.params = state["params"] if isinstance(state, dict) \
+        and "params" in state else state
+
+    obs = env.observation(env.players()[0])
+    export_onnx(model, obs, out)
+    size = os.path.getsize(out)
+    print(f"wrote {out} ({size / 1024:.0f} KiB)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,169 @@
+"""ONNX interop: jaxpr export, numpy runtime, --eval round trip.
+
+Capability parity with the reference's onnx path
+(/root/reference/handyrl/evaluation.py:287-365 eval side,
+/root/reference/scripts/make_onnx_model.py export side) — implemented
+without the onnx/onnxruntime packages (absent from this image):
+hand-encoded protobuf + a numpy graph interpreter.
+
+Tolerances note: jax's CPU convolutions go through oneDNN, which uses
+reduced-precision fast math (~1e-2 relative vs float64 truth, measured)
+— the numpy runner is exact f32, so comparisons against the jax
+reference use oneDNN-sized tolerances.
+"""
+
+import numpy as np
+import pytest
+
+TOL = dict(rtol=2e-2, atol=2e-3)  # oneDNN conv fast-math headroom
+
+
+def _export(env_name, tmp_path, seed=0):
+    from handyrl_tpu.environment import make_env
+    from handyrl_tpu.interop.onnx_export import export_onnx
+    from handyrl_tpu.models import TPUModel
+
+    env = make_env({"env": env_name})
+    env.reset()
+    model = TPUModel(env.net())
+    obs = env.observation(env.players()[0])
+    model.init_params(obs, seed=seed)
+    path = str(tmp_path / f"{env_name}.onnx")
+    export_onnx(model, obs, path)
+    return env, model, obs, path
+
+
+@pytest.mark.parametrize("env_name", ["TicTacToe", "HungryGeese"])
+def test_export_matches_flax(env_name, tmp_path):
+    from handyrl_tpu.interop.onnx_run import OnnxModel
+
+    env, model, obs, path = _export(env_name, tmp_path)
+    om = OnnxModel(path)
+    out = om.inference(obs)
+    ref = model.inference(obs)
+    np.testing.assert_allclose(
+        out["policy"], np.asarray(ref["policy"], np.float32), **TOL)
+    np.testing.assert_allclose(
+        out["value"], np.asarray(ref["value"], np.float32), **TOL)
+    assert out["hidden"] is None
+
+
+def test_recurrent_export_carries_hidden(tmp_path):
+    """The DRC net unrolls: hidden state is explicit graph I/O and two
+    different observations must produce different carried states."""
+    from handyrl_tpu.interop.onnx_run import OnnxModel
+
+    env, model, obs, path = _export("Geister", tmp_path)
+    om = OnnxModel(path)
+    hid = om.init_hidden()
+    assert hid, "recurrent export must expose hidden inputs"
+    out1 = om.inference(obs, hid)
+    assert out1["hidden"] and len(out1["hidden"]) == len(hid)
+
+    ref_out = model.inference(obs, model.init_hidden())
+    np.testing.assert_allclose(
+        out1["policy"], np.asarray(ref_out["policy"], np.float32),
+        **TOL)
+    # carried state actually evolves
+    assert any(np.abs(h).max() > 0 for h in out1["hidden"])
+    out2 = om.inference(obs, out1["hidden"])
+    assert not np.allclose(out2["policy"], out1["policy"])
+
+
+def test_eval_plays_full_match_with_onnx_artifact(tmp_path, monkeypatch):
+    """--eval of an exported .onnx plays real games end to end
+    (the reference capability: evaluation.py:287-365)."""
+    from handyrl_tpu.environment import make_env
+    from handyrl_tpu.evaluation import exec_match, load_model
+    from handyrl_tpu.agent import Agent, RandomAgent
+
+    env, model, obs, path = _export("TicTacToe", tmp_path)
+    loaded = load_model(path, env)
+    agents = {0: Agent(loaded), 1: RandomAgent()}
+    results = [exec_match(env, agents) for _ in range(5)]
+    assert all(r is not None for r in results)
+    outcomes = [r[0] for r in results]
+    assert all(-1.0 <= o <= 1.0 for o in outcomes)
+
+
+def test_onnx_file_parses_as_protobuf(tmp_path):
+    """The artifact is structurally valid: our decoder round-trips it
+    and the graph carries nodes, initializers, and named I/O."""
+    from handyrl_tpu.interop.onnx_proto import decode
+
+    _, _, _, path = _export("TicTacToe", tmp_path)
+    with open(path, "rb") as f:
+        model = decode(f.read(), "Model")
+    g = model["graph"]
+    assert model["opset_import"][0]["version"] >= 13
+    assert len(g["node"]) > 10
+    assert len(g["initializer"]) > 5
+    names = [vi["name"] for vi in g["input"]]
+    assert any(n.startswith("input") for n in names)
+    out_names = [vi["name"] for vi in g["output"]]
+    assert "policy" in out_names and "value" in out_names
+
+
+def test_runner_executes_foreign_style_graph():
+    """A hand-built NCHW Conv+BN+Relu+Gemm graph (the shape of a torch
+    export) runs correctly — interop is not limited to our own files."""
+    from handyrl_tpu.interop.onnx_proto import decode, encode
+    from handyrl_tpu.interop.onnx_run import OnnxModel
+    from handyrl_tpu.interop.onnx_export import (
+        _value_info,
+        numpy_to_tensor,
+        _attr,
+    )
+    import tempfile
+
+    rng = np.random.default_rng(3)
+    w = rng.normal(size=(4, 2, 3, 3)).astype(np.float32)
+    b = rng.normal(size=(4,)).astype(np.float32)
+    scale = np.ones(4, np.float32)
+    bias = np.zeros(4, np.float32)
+    mean = np.zeros(4, np.float32)
+    var = np.ones(4, np.float32)
+    dense = rng.normal(size=(4 * 5 * 5, 3)).astype(np.float32)
+
+    def node(op, inputs, outputs, **attrs):
+        return {"op_type": op, "input": inputs, "output": outputs,
+                "attribute": [_attr(k, v) for k, v in attrs.items()]}
+
+    graph = {
+        "name": "foreign",
+        "node": [
+            node("Conv", ["x", "w", "b"], ["c"],
+                 pads=[1, 1, 1, 1], strides=[1, 1]),
+            node("BatchNormalization",
+                 ["c", "scale", "bias", "mean", "var"], ["n"]),
+            node("Relu", ["n"], ["r"]),
+            node("Flatten", ["r"], ["f"], axis=1),
+            node("Gemm", ["f", "dense"], ["policy"]),
+        ],
+        "initializer": [
+            numpy_to_tensor(a, n) for a, n in [
+                (w, "w"), (b, "b"), (scale, "scale"), (bias, "bias"),
+                (mean, "mean"), (var, "var"), (dense, "dense")]
+        ],
+        "input": [_value_info("x", (1, 2, 5, 5))],
+        "output": [_value_info("policy", (1, 3))],
+    }
+    blob = encode({"ir_version": 8, "graph": graph,
+                   "opset_import": [{"domain": "", "version": 13}]},
+                  "Model")
+    with tempfile.NamedTemporaryFile(suffix=".onnx", delete=False) as f:
+        f.write(blob)
+        path = f.name
+
+    om = OnnxModel(path)
+    x = rng.normal(size=(2, 5, 5)).astype(np.float32)
+    out = om.inference(x)
+    assert out["policy"].shape == (3,)
+    assert np.all(np.isfinite(out["policy"]))
+    # verify against a straightforward numpy computation
+    from handyrl_tpu.interop.onnx_run import _conv
+
+    c = _conv(x[None], w, b, {"pads": [1, 1, 1, 1]})
+    r = np.maximum(c, 0)
+    expect = r.reshape(1, -1) @ dense
+    np.testing.assert_allclose(out["policy"], expect[0], rtol=1e-5)

@@ -118,16 +118,17 @@ CFG_BASE = {
 }
 
 
-@pytest.mark.parametrize("env_name,turn_based,burn_in", [
-    ("TicTacToe", True, 0),        # turn mode
-    ("TicTacToe", True, 3),        # turn mode + burn-in alignment
-    ("HungryGeese", False, 0),     # seat mode (flagship)
-    ("Geister", True, 4),          # turn mode, long RNN episodes
+@pytest.mark.parametrize("env_name,turn_based,burn_in,observation", [
+    ("TicTacToe", True, 0, False),    # turn mode
+    ("TicTacToe", True, 3, False),    # turn mode + burn-in alignment
+    ("HungryGeese", False, 0, False),  # seat mode (flagship)
+    ("Geister", True, 4, False),      # turn mode, long RNN episodes
+    ("Geister", True, 4, True),       # all mode (observation training)
 ])
 def test_device_gather_matches_make_batch(
-        env_name, turn_based, burn_in, monkeypatch):
+        env_name, turn_based, burn_in, observation, monkeypatch):
     cfg = dict(CFG_BASE, turn_based_training=turn_based,
-               burn_in_steps=burn_in)
+               burn_in_steps=burn_in, observation=observation)
     episodes, players = _make_episodes(env_name, cfg, count=6)
     draws = _draws(episodes, cfg, n=12, players=players, seed=13)
     host = _host_batch(episodes, draws, cfg, players, monkeypatch)
