@@ -121,7 +121,16 @@ _UNARY = {
     "neg": "Neg", "exp": "Exp", "log": "Log", "tanh": "Tanh",
     "logistic": "Sigmoid", "sqrt": "Sqrt", "abs": "Abs",
     "stop_gradient": "Identity", "copy": "Identity",
-    "floor": "Floor", "is_finite": "Identity",
+    "floor": "Floor", "not": "Not",
+}
+
+# call-like primitives that are safe to inline as straight-line code.
+# lax.scan/while/cond also carry inner jaxprs but have LOOP semantics —
+# they must hit the NotImplementedError path, not silent mis-inlining.
+_INLINE_CALLS = {
+    "pjit", "jit", "closed_call", "core_call", "custom_jvp_call",
+    "custom_vjp_call", "custom_vjp_call_jaxpr", "remat", "checkpoint",
+    "custom_jvp_call_jaxpr",
 }
 _BINARY = {
     "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div",
@@ -195,6 +204,11 @@ def _emit_eqn(b, eqn):
 
     # call-like primitives: inline the inner jaxpr
     inner = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")
+    if inner is not None and p not in _INLINE_CALLS:
+        raise NotImplementedError(
+            f"jaxpr primitive {p!r} carries an inner jaxpr with "
+            f"non-inline semantics (loops/conditionals); unroll it in "
+            f"the model (python loop) to export")
     if inner is not None:
         if hasattr(inner, "jaxpr"):  # ClosedJaxpr
             const_names = [b.const(np.asarray(c), "c")
@@ -220,6 +234,11 @@ def _emit_eqn(b, eqn):
         out = b.node("Reciprocal", [b.node("Sqrt", names)])
     elif p == "square":
         out = b.node("Mul", [names[0], names[0]])
+    elif p == "is_finite":
+        out = b.node("Not", [b.node("Or", [
+            b.node("IsNaN", [names[0]]),
+            b.node("IsInf", [names[0]]),
+        ])])
     elif p == "cbrt":
         out = b.node("Pow", [names[0], b.const(np.float32(1 / 3))])
     elif p == "integer_pow":

@@ -88,7 +88,21 @@ def _conv(x, w, b, attrs):
     group = int(attrs.get("group", 1))
     strides = attrs.get("strides", [1, 1])
     dilations = attrs.get("dilations", [1, 1])
-    pads = attrs.get("pads", [0, 0, 0, 0])  # t, l, b, r
+    auto_pad = attrs.get("auto_pad", "NOTSET")
+    if auto_pad in ("SAME_UPPER", "SAME_LOWER"):
+        pads = [0, 0, 0, 0]
+        for i, (size, kern) in enumerate(zip(x.shape[2:],
+                                             w.shape[2:])):
+            eff = (kern - 1) * dilations[i] + 1
+            out_sz = -(-size // strides[i])  # ceil
+            total = max((out_sz - 1) * strides[i] + eff - size, 0)
+            lo = total // 2 if auto_pad == "SAME_UPPER" \
+                else total - total // 2
+            pads[i], pads[i + 2] = lo, total - lo
+    elif auto_pad not in ("NOTSET", "VALID"):
+        raise NotImplementedError(f"Conv auto_pad={auto_pad}")
+    else:
+        pads = attrs.get("pads", [0, 0, 0, 0])  # t, l, b, r
     N, C, H, W = x.shape
     M, Cg, KH, KW = w.shape
     x = np.pad(x, ((0, 0), (0, 0), (pads[0], pads[2]),
@@ -124,7 +138,7 @@ def _pool(x, attrs, reducer, is_avg):
     if attrs.get("ceil_mode"):
         raise NotImplementedError("pooling with ceil_mode=1")
     k = attrs["kernel_shape"]
-    strides = attrs.get("strides", k)
+    strides = attrs.get("strides", [1] * len(k))  # ONNX default: 1
     pads = attrs.get("pads", [0] * 4)
     fill = 0.0 if is_avg else -np.inf
     x = np.pad(x, ((0, 0), (0, 0), (pads[0], pads[2]),
@@ -257,6 +271,10 @@ class _Runner:
                  "Or": np.logical_or, "Xor": np.logical_xor}[op](x, y)
         elif op == "Not":
             r = np.logical_not(x)
+        elif op == "IsNaN":
+            r = np.isnan(x)
+        elif op == "IsInf":
+            r = np.isinf(x)
         elif op == "Floor":
             r = np.floor(x)
         elif op == "Where":

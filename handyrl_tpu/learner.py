@@ -411,10 +411,12 @@ class Trainer:
 
         self.device_replay = self._maybe_device_replay()
         self._replay_step = None
-        if self.device_replay is not None:
+        if self.device_replay is not None and not self.multihost:
             from .staging import make_replay_update_step
 
             # ONE jitted program per step: gather + loss + grad + Adam
+            # (multi-host instead assembles global batches from the
+            # local rings and runs the global update_step)
             self._replay_step = make_replay_update_step(
                 self.device_replay, self.model, self.loss_cfg,
                 self.optimizer, self.compute_dtype,
@@ -429,18 +431,43 @@ class Trainer:
 
     def _maybe_device_replay(self):
         """Build the HBM-resident replay (staging.DeviceReplay) when
-        configured.  auto = on for single-process learners; multi-host
-        keeps the host batcher path (per-process rings + global-array
-        assembly is future work)."""
+        configured (auto = on).
+
+        Multi-host: each process keeps its OWN ring over a LOCAL mesh
+        of its addressable devices; the gather emits this process's
+        per-device batch shards, and ``_epoch_loop_multihost``
+        assembles them into global arrays without any cross-host data
+        movement.  Requires batch rows to divide evenly over all
+        devices; otherwise falls back to the host batcher path."""
         mode = self.args.get("device_replay", "auto") or "auto"
         if self.optimizer is None or mode == "off":
             return None
+        mesh = self.train_mesh
         if self.multihost:
-            if mode == "on":
-                raise ValueError(
-                    "device_replay: on is not yet supported with "
-                    "multi-host training; set device_replay: off")
-            return None
+            # local-shard assembly is only shape-compatible with a
+            # pure-dp global mesh spanning every device: then global
+            # rows-per-device == local rows-per-device.  sp/tp meshes
+            # replicate batch rows across non-dp axes, which per-device
+            # local gathers cannot reproduce.
+            n_local = jax.local_device_count()
+            local_bs = self.args["batch_size"] // jax.process_count()
+            msg = None
+            if (mesh is None
+                    or mesh.shape["sp"] != 1 or mesh.shape["tp"] != 1
+                    or mesh.size != jax.device_count()):
+                msg = ("multi-host device replay requires a pure-dp "
+                       "mesh over all devices")
+            elif local_bs % n_local != 0:
+                msg = (f"device replay needs local batch {local_bs} "
+                       f"divisible by {n_local} local devices")
+            if msg:
+                if mode == "on":
+                    raise ValueError(msg)
+                print(msg + ": using the host batcher path")
+                return None
+            from jax.sharding import Mesh
+
+            mesh = Mesh(np.asarray(jax.local_devices()), ("dp",))
         from .staging import DeviceReplay
 
         cfg = {
@@ -455,8 +482,7 @@ class Trainer:
                     or self.args["maximum_episodes"])
         max_bytes = (self.args.get("device_replay_mb", 4096)
                      or 4096) << 20
-        return DeviceReplay(cfg, capacity, max_bytes,
-                            mesh=self.train_mesh)
+        return DeviceReplay(cfg, capacity, max_bytes, mesh=mesh)
 
     def _sync_initial_state(self):
         """Broadcast process 0's full train state so replicas provably
@@ -660,6 +686,38 @@ class Trainer:
             batch_cnt += 1
         return batch_cnt, metric_acc
 
+    def _global_from_local_shards(self, local_batch):
+        """Assemble global batch arrays from this process's local
+        per-device shards (device replay under multi-host).  Pure
+        metadata: the shards stay where the local gather put them."""
+        n_proc = jax.process_count()
+
+        def leaf(arr):
+            shards = [s.data for s in arr.addressable_shards]
+            gshape = (arr.shape[0] * n_proc,) + arr.shape[1:]
+            return jax.make_array_from_single_device_arrays(
+                gshape, self.batch_sharding, shards)
+
+        return jax.tree.map(leaf, local_batch)
+
+    def _next_multihost_batch(self):
+        """One committed step's batch: device replay (local ring ->
+        global assembly) or the host prefetcher."""
+        if self.device_replay is not None:
+            with self.timers.section("ingest"):
+                self.device_replay.ingest(max_episodes=8)
+            with self.timers.section("batch_wait"):
+                local_bs = (self.args["batch_size"]
+                            // jax.process_count())
+                local = self.device_replay.sample(local_bs)
+                return self._global_from_local_shards(local)
+        while True:
+            try:
+                with self.timers.section("batch_wait"):
+                    return self.prefetcher.get(timeout=1)
+            except queue.Empty:
+                continue
+
     def _epoch_loop_multihost(self):
         """Multi-process epoch: process 0 decides, everyone executes the
         same step count.  Each iteration syncs one control word (STEP /
@@ -668,8 +726,16 @@ class Trainer:
         by construction (the SPMD contract)."""
         from .parallel import multihost as mh
 
+        cap = int(self.args.get("updates_per_epoch", 0) or 0)
         batch_cnt, metric_acc = 0, []
         while True:
+            if self.primary and cap and batch_cnt >= cap:
+                # epoch budget spent: hold the next control sync until
+                # the learner asks for the snapshot (replicas simply
+                # wait in the collective)
+                while not (self.update_flag or self.shutdown_flag
+                           or self.failure is not None):
+                    time.sleep(0.01)
             code = mh.STEP
             if self.primary:
                 if self.shutdown_flag or self.failure is not None:
@@ -683,16 +749,10 @@ class Trainer:
             if code == mh.EPOCH_END:
                 return batch_cnt, metric_acc
             # committed to one more global step: block until this
-            # process's shard arrives (peers are already waiting in
+            # process's shard is ready (peers are already waiting in
             # the collective; a dead feed here stalls the job until
             # the distributed runtime's heartbeat fails it)
-            while True:
-                try:
-                    with self.timers.section("batch_wait"):
-                        batch = self.prefetcher.get(timeout=1)
-                    break
-                except queue.Empty:
-                    continue
+            batch = self._next_multihost_batch()
             metric_acc.append(self._do_update(batch))
             batch_cnt += 1
 

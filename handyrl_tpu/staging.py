@@ -193,9 +193,14 @@ class DeviceReplay:
                 self.pending.popleft()
                 self.dropped += 1
 
-    def ingest(self, max_episodes=64):
+    def ingest(self, max_episodes=64, batch=8):
         """Trainer-thread only: move pending episodes into the device
-        ring.  Bounded per call so one call can't stall an update."""
+        ring.  Bounded per call so one call can't stall an update.
+
+        Episodes fill CONSECUTIVE ring slots, so up to ``batch`` of
+        them upload as ONE device write (a single dynamic-update-slice
+        of ``k * t_max`` rows) — per-dispatch latency, not bandwidth,
+        dominates small uploads, especially through tunneled hosts."""
         if self.buffers is None:
             # size T_max from everything already waiting (the warmup
             # backlog usually contains a near-maximal episode, saving
@@ -206,12 +211,28 @@ class DeviceReplay:
                         self.t_max,
                         _round_up(max(e["steps"]
                                       for e in self.pending if e)))
-        for _ in range(max_episodes):
+        done = 0
+        while done < max_episodes:
+            cols = []
             with self._lock:
-                if not self.pending:
-                    return
-                ep = self.pending.popleft()
-            self._append(_decompress_episode(ep))
+                while self.pending and len(cols) < batch:
+                    cols.append(self.pending.popleft())
+            if not cols:
+                return
+            cols = [_decompress_episode(ep) for ep in cols]
+            done += len(cols)
+            if self.buffers is None:
+                self._append(cols.pop(0))  # sizes + allocates buffers
+            while cols:
+                # one write per run of consecutive slots (the ring may
+                # wrap, and a long episode may force growth first)
+                k = min(len(cols), self.capacity - self.write_ptr)
+                run = cols[:k]
+                if any(len(c["turn_idx"]) > self.t_max for c in run):
+                    self._append(cols.pop(0))  # grows, then resume
+                    continue
+                self._append_run(run)
+                del cols[:k]
 
     # -- buffer management -------------------------------------------
 
@@ -355,6 +376,25 @@ class DeviceReplay:
             "ep_len": np.asarray([T], np.int32),
             "ep_total": np.asarray([col["steps"]], np.int32),
         }
+
+    def _append_run(self, cols):
+        """Write ``len(cols)`` episodes into consecutive slots with ONE
+        device dispatch.  Callers guarantee: buffers exist, no episode
+        exceeds t_max, and the run fits before the ring wraps."""
+        if len(cols) == 1:
+            return self._append(cols[0])
+        eps = [self._pad_episode(c) for c in cols]
+        ep = {key: jax.tree.map(
+            lambda *arrs: np.concatenate(arrs),
+            *[e[key] for e in eps]) for key in eps[0]}
+        slot = self.write_ptr
+        self.buffers = self._append_fn(self.buffers, ep, slot)
+        for i, col in enumerate(cols):
+            self.ep_len[slot + i] = len(col["turn_idx"])
+        k = len(cols)
+        self.write_ptr = (slot + k) % self.capacity
+        self.size = min(self.size + k, self.capacity)
+        self.episodes_seen += k
 
     def _append(self, col):
         T = len(col["turn_idx"])

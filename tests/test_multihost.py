@@ -24,6 +24,7 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 pid, port = int(sys.argv[1]), int(sys.argv[2])
+device_replay = sys.argv[3]
 
 args = {
     "env_args": {"env": "TicTacToe"},
@@ -49,6 +50,7 @@ args = {
         "value_target": "TD",
         "seed": 3,
         "lockstep_episodes": 4,
+        "device_replay": device_replay,
         "mesh": {"dp": 8},
         "distributed": {
             "coordinator_address": "127.0.0.1:%d" % port,
@@ -68,7 +70,10 @@ if __name__ == "__main__":  # spawn-safe: children re-import this file
 
 
 @pytest.mark.slow
-def test_two_process_learner(tmp_path):
+@pytest.mark.parametrize("device_replay", ["on", "off"])
+def test_two_process_learner(tmp_path, device_replay):
+    """Both multi-host feed paths: per-process HBM rings assembled
+    into global batches (on) and the host batcher path (off)."""
     port = find_free_port()
     script = tmp_path / "child.py"
     script.write_text(CHILD)
@@ -80,7 +85,8 @@ def test_two_process_learner(tmp_path):
 
     procs = [
         subprocess.Popen(
-            [sys.executable, str(script), str(pid), str(port)],
+            [sys.executable, str(script), str(pid), str(port),
+             device_replay],
             cwd=tmp_path, env=env, stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, text=True)
         for pid in range(2)

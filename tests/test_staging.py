@@ -176,6 +176,32 @@ def test_ring_eviction_and_growth():
     assert bool(jnp.all(jnp.isfinite(batch["selected_prob"])))
 
 
+def test_batched_ingest_equals_single_appends():
+    """offer() + batched ingest() writes the same ring as one-by-one
+    appends (consecutive-slot runs upload as a single device write)."""
+    import jax
+
+    from handyrl_tpu.staging import DeviceReplay, _decompress_episode
+
+    cfg = dict(CFG_BASE, turn_based_training=True)
+    episodes, _ = _make_episodes("TicTacToe", cfg, count=9)
+
+    ref = DeviceReplay(cfg, capacity=16, max_bytes=1 << 30)
+    for ep in episodes:
+        ref._append(_decompress_episode(ep))
+
+    batched = DeviceReplay(cfg, capacity=16, max_bytes=1 << 30)
+    batched.offer(episodes)
+    batched.ingest(batch=4)
+
+    assert batched.size == ref.size
+    assert batched.write_ptr == ref.write_ptr
+    np.testing.assert_array_equal(batched.ep_len, ref.ep_len)
+    for a, b in zip(jax.tree.leaves(ref.buffers),
+                    jax.tree.leaves(batched.buffers)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_growth_respects_byte_budget():
     """When wider slots no longer fit the budget, growth shrinks the
     ring, keeping the newest episodes."""
