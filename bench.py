@@ -329,7 +329,7 @@ def measure_device_replay(seed, batch_size, compute_dtype, steps=40):
     import jax.numpy as jnp
 
     from handyrl_tpu.ops.losses import LossConfig
-    from handyrl_tpu.ops.update import make_optimizer, make_update_step
+    from handyrl_tpu.ops.update import make_optimizer
     from handyrl_tpu.staging import DeviceReplay, _decompress_episode
     from handyrl_tpu.utils.profiling import SectionTimers
 

@@ -382,14 +382,15 @@ class Trainer:
         self.failure = None
         self.update_queue = queue.Queue(maxsize=1)
         # multi-host: this process is one controller of a global mesh;
-        # its batchers build 1/process_count of every global batch
+        # its feed builds 1/process_count of every global batch
         self.multihost = jax.process_count() > 1
         self.primary = jax.process_index() == 0
-        local_bs = None
+        self.updates_cap = int(args.get("updates_per_epoch", 0) or 0)
+        self.local_batch_size = args["batch_size"]
         if self.multihost:
             from .parallel.multihost import local_batch_size
 
-            local_bs = local_batch_size(args["batch_size"])
+            self.local_batch_size = local_batch_size(args["batch_size"])
         self.batch_sharding = None
         self.train_mesh = None
         self.train_fsdp = False
@@ -427,7 +428,7 @@ class Trainer:
         self.batcher = None
         if self.optimizer is not None and self.device_replay is None:
             self.batcher = Batcher(self.args, self.episodes,
-                                   batch_size=local_bs)
+                                   batch_size=self.local_batch_size)
 
     def _maybe_device_replay(self):
         """Build the HBM-resident replay (staging.DeviceReplay) when
@@ -450,7 +451,7 @@ class Trainer:
             # replicate batch rows across non-dp axes, which per-device
             # local gathers cannot reproduce.
             n_local = jax.local_device_count()
-            local_bs = self.args["batch_size"] // jax.process_count()
+            local_bs = self.local_batch_size
             msg = None
             if (mesh is None
                     or mesh.shape["sp"] != 1 or mesh.shape["tp"] != 1
@@ -632,7 +633,7 @@ class Trainer:
     def _epoch_loop_local(self):
         """Single-process epoch: train until the learner asks for the
         snapshot (and at least one batch has landed)."""
-        cap = int(self.args.get("updates_per_epoch", 0) or 0)
+        cap = self.updates_cap
         batch_cnt, metric_acc = 0, []
         while batch_cnt == 0 or not self.update_flag:
             if self.shutdown_flag:
@@ -658,7 +659,7 @@ class Trainer:
 
         replay = self.device_replay
         batch_size = self.args["batch_size"]
-        cap = int(self.args.get("updates_per_epoch", 0) or 0)
+        cap = self.updates_cap
         batch_cnt, metric_acc = 0, []
         while batch_cnt == 0 or not self.update_flag:
             if self.shutdown_flag:
@@ -707,9 +708,7 @@ class Trainer:
             with self.timers.section("ingest"):
                 self.device_replay.ingest(max_episodes=8)
             with self.timers.section("batch_wait"):
-                local_bs = (self.args["batch_size"]
-                            // jax.process_count())
-                local = self.device_replay.sample(local_bs)
+                local = self.device_replay.sample(self.local_batch_size)
                 return self._global_from_local_shards(local)
         while True:
             try:
@@ -726,7 +725,7 @@ class Trainer:
         by construction (the SPMD contract)."""
         from .parallel import multihost as mh
 
-        cap = int(self.args.get("updates_per_epoch", 0) or 0)
+        cap = self.updates_cap
         batch_cnt, metric_acc = 0, []
         while True:
             if self.primary and cap and batch_cnt >= cap:

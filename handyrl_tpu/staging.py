@@ -147,7 +147,6 @@ class DeviceReplay:
 
             self._rep = NamedSharding(mesh, P())
             self._out = NamedSharding(mesh, P("dp"))
-        self.requested_capacity = int(capacity)
         self.capacity = int(capacity)   # may shrink to fit max_bytes
         self.max_bytes = int(max_bytes)
         self.forward_steps = cfg["forward_steps"]
@@ -280,7 +279,7 @@ class DeviceReplay:
         self.shapes = {
             "prob": (P, 1), "act": (P, 1), "amask": (P, A),
             "value": (P, 1), "reward": (P, 1), "return": (P, 1),
-            "tmask": (P, 1), "omask": (P, 1), "turn_idx": (),
+            "tmask": (P, 1), "omask": (P, 1),
         }
 
         def flat2d(shape, dtype):
