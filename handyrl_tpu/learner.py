@@ -415,14 +415,16 @@ class Trainer:
         if self.device_replay is not None and not self.multihost:
             from .staging import make_replay_update_step
 
-            # ONE jitted program per step: gather + loss + grad + Adam
-            # (multi-host instead assembles global batches from the
-            # local rings and runs the global update_step)
+            # ONE jitted program per step: draw + gather + loss + grad
+            # + Adam — the host passes three scalars (multi-host
+            # instead assembles global batches from the local rings
+            # and runs the global update_step)
             self._replay_step = make_replay_update_step(
                 self.device_replay, self.model, self.loss_cfg,
                 self.optimizer, self.compute_dtype,
+                batch_size=self.args["batch_size"],
                 mesh=self.train_mesh, params=self.params,
-                fsdp=self.train_fsdp)
+                fsdp=self.train_fsdp, seed=self.args.get("seed", 0))
         # the host batcher farm exists only when the device-resident
         # path is off: skipping it frees host cores for actors
         self.batcher = None
@@ -652,13 +654,11 @@ class Trainer:
         return batch_cnt, metric_acc
 
     def _epoch_loop_device(self):
-        """Device-replay epoch: gather + update run as ONE jitted
-        program per step; the host only drains newly arrived episodes
-        into the ring (bounded per step) and draws index vectors."""
-        import jax.numpy as jnp
-
+        """Device-replay epoch: draw + gather + update run as ONE
+        jitted program per step fed three host scalars; the host only
+        drains newly arrived episodes into the ring (bounded per
+        step)."""
         replay = self.device_replay
-        batch_size = self.args["batch_size"]
         cap = self.updates_cap
         batch_cnt, metric_acc = 0, []
         while batch_cnt == 0 or not self.update_flag:
@@ -673,14 +673,11 @@ class Trainer:
                 # the snapshot, releasing host CPU to the actors
                 time.sleep(0.01)
                 continue
-            with self.timers.section("batch_wait"):
-                slots, tstarts, seats = replay.draw_indices(batch_size)
             with self.timers.section("update"):
                 (self.params, self.opt_state,
                  metrics) = self._replay_step(
                     self.params, self.opt_state, replay.buffers,
-                    jnp.asarray(slots), jnp.asarray(tstarts),
-                    jnp.asarray(seats))
+                    replay.size, replay.oldest, self.steps)
             self.trace.tick()
             self.steps += 1
             metric_acc.append(metrics)

@@ -59,12 +59,14 @@ from .utils.tree import tree_map
 
 
 def make_replay_update_step(replay, model, loss_cfg, optimizer,
-                            compute_dtype, mesh=None, params=None,
-                            fsdp=False):
-    """ONE jitted program per training step: ring gather -> loss ->
-    grad -> Adam.  Fusing the batch gather into the update step halves
-    per-step dispatches and lets XLA stream gathered windows straight
-    into the forward pass instead of materializing a batch in HBM.
+                            compute_dtype, batch_size, mesh=None,
+                            params=None, fsdp=False, seed=0):
+    """ONE jitted program per training step: index draw -> ring gather
+    -> loss -> grad -> Adam.  Everything happens on device — the host
+    contributes three SCALARS per call (ring fill, oldest slot, step
+    counter), so a training step uploads nothing at all.  The draw
+    folds the step counter into a fixed PRNG key and reproduces the
+    triangular recency bias + uniform window/seat choice in-jit.
 
     With a mesh, params/optimizer keep their usual shardings while the
     ring rides replicated and the gathered batch is constrained onto
@@ -73,8 +75,11 @@ def make_replay_update_step(replay, model, loss_cfg, optimizer,
     from .ops.update import make_update_core
 
     core = make_update_core(model, loss_cfg, optimizer, compute_dtype)
+    base_key = jax.random.PRNGKey(seed)
 
-    def step(params, opt_state, buffers, slots, tstarts, seats):
+    def step(params, opt_state, buffers, size, oldest, step_idx):
+        slots, tstarts, seats = replay._draw_on_device(
+            buffers, size, oldest, step_idx, base_key, batch_size)
         batch = replay._gather_batch(buffers, slots, tstarts, seats)
         if replay._out is not None:
             batch = jax.tree.map(
@@ -93,7 +98,7 @@ def make_replay_update_step(replay, model, loss_cfg, optimizer,
     o_shard = opt_state_sharding(optimizer, params, p_shard, rep)
     return jax.jit(
         step,
-        in_shardings=(p_shard, o_shard, rep, rep, rep, rep),
+        in_shardings=(p_shard, o_shard, rep, None, None, None),
         out_shardings=(p_shard, o_shard, rep),
         donate_argnums=(0, 1),
     )
@@ -462,6 +467,11 @@ class DeviceReplay:
 
     # -- sampling -----------------------------------------------------
 
+    @property
+    def oldest(self):
+        """Ring slot of the oldest live episode (host mirror)."""
+        return (self.write_ptr - self.size) % self.capacity
+
     def draw_indices(self, batch_size):
         """Host-side draw: recency-biased episode choice + random
         training window, as three int32 vectors.
@@ -474,7 +484,7 @@ class DeviceReplay:
             self._rng = np.random.default_rng(random.getrandbits(64))
         rng = self._rng
         n = self.size
-        oldest = (self.write_ptr - n) % self.capacity
+        oldest = self.oldest
         # (idx+1)(idx+2) <= u*n*(n+1) + 2  =>  triangular idx
         u = rng.random(batch_size)
         idx = np.floor(
@@ -497,6 +507,38 @@ class DeviceReplay:
         return self._sample_fn(
             self.buffers, jnp.asarray(slots), jnp.asarray(tstarts),
             jnp.asarray(seats))
+
+    def _draw_on_device(self, buffers, size, oldest, step_idx,
+                        base_key, batch_size):
+        """The draw_indices math as traced jax ops (used inside the
+        fused update step, so a step needs no per-call array uploads).
+        Same distributions as the host draw — triangular recency over
+        the ring, uniform window start, uniform seat — on a different
+        RNG stream (jax PRNG keyed by the config seed + step counter;
+        like the host path, which draws from the ``random`` module the
+        Learner seeds with ``args['seed']``, the stream is
+        config-seed-deterministic)."""
+        key = jax.random.fold_in(base_key, step_idx)
+        k1, k2, k3 = jax.random.split(key, 3)
+        size = jnp.asarray(size)
+        n = size.astype(jnp.float32)
+        u = jax.random.uniform(k1, (batch_size,))
+        idx = jnp.floor(
+            (jnp.sqrt(1.0 + 4.0 * u * n * (n + 1)) - 3.0) / 2.0
+        ).astype(jnp.int32) + 1
+        idx = jnp.clip(idx, 0, size - 1)
+        slots = (oldest + idx) % self.capacity
+        cands = 1 + jnp.maximum(
+            0, buffers["ep_len"][slots] - self.forward_steps)
+        tstarts = jnp.floor(
+            jax.random.uniform(k2, (batch_size,)) * cands
+        ).astype(jnp.int32)
+        if self.mode == "seat":
+            seats = jax.random.randint(
+                k3, (batch_size,), 0, self.num_players, jnp.int32)
+        else:
+            seats = jnp.zeros(batch_size, jnp.int32)
+        return slots, tstarts, seats
 
     # The gather: all of make_batch's semantics, on device.
     def _gather_batch(self, buffers, slots, tstarts, seats):

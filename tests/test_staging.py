@@ -176,6 +176,46 @@ def test_ring_eviction_and_growth():
     assert bool(jnp.all(jnp.isfinite(batch["selected_prob"])))
 
 
+def test_device_draw_distribution_and_determinism():
+    """The in-jit index draw reproduces the host draw's distributions
+    (triangular recency, uniform window, uniform seat) and is
+    deterministic in the step counter."""
+    import jax
+    import jax.numpy as jnp
+
+    from handyrl_tpu.staging import DeviceReplay, _decompress_episode
+
+    cfg = dict(CFG_BASE, turn_based_training=False)  # seat mode
+    episodes, players = _make_episodes("TicTacToe", cfg, count=10)
+    replay = DeviceReplay(cfg, capacity=16, max_bytes=1 << 30)
+    for ep in episodes:
+        replay._append(_decompress_episode(ep))
+
+    key = jax.random.PRNGKey(0)
+    B = 4096
+    draw = jax.jit(lambda s: replay._draw_on_device(
+        replay.buffers, replay.size, replay.oldest, s, key, B))
+    slots, tstarts, seats = draw(7)
+    slots2, _, _ = draw(7)
+    np.testing.assert_array_equal(np.asarray(slots), np.asarray(slots2))
+    slots3, _, _ = draw(8)
+    assert not np.array_equal(np.asarray(slots), np.asarray(slots3))
+
+    # triangular over insertion order: newest ~n times oldest's mass
+    n = replay.size
+    order = (np.asarray(slots) - replay.oldest) % replay.capacity
+    freq = np.bincount(order, minlength=n) / B
+    expect = (np.arange(n) + 1) / (n * (n + 1) / 2)
+    np.testing.assert_allclose(freq, expect, atol=0.02)
+    # windows within bounds; seats uniform over players
+    lens = replay.ep_len[np.asarray(slots)]
+    cands = 1 + np.maximum(0, lens - cfg["forward_steps"])
+    assert np.all(np.asarray(tstarts) >= 0)
+    assert np.all(np.asarray(tstarts) < cands)
+    assert set(np.unique(np.asarray(seats))) == set(
+        range(len(players)))
+
+
 def test_batched_ingest_equals_single_appends():
     """offer() + batched ingest() writes the same ring as one-by-one
     appends (consecutive-slot runs upload as a single device write)."""
