@@ -324,7 +324,7 @@ def _emit_eqn(b, eqn):
         arr = np.broadcast_to(
             np.arange(shape[dim]).reshape(
                 [-1 if i == dim else 1 for i in range(len(shape))]),
-            shape).astype(np.float32)
+            shape).astype(eqn.outvars[0].aval.dtype)
         out = b.const(arr, "iota")
     else:
         raise NotImplementedError(

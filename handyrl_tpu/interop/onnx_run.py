@@ -446,14 +446,16 @@ class OnnxModel:
         leaves = jax.tree.leaves(x)
         if hidden is not None:
             leaves = leaves + list(jax.tree.leaves(hidden))
-        names = ([vi["name"] for vi in self._data_inputs]
-                 + [vi["name"] for vi in self._hidden_inputs])
-        if len(leaves) != len(names):
+        vis = self._data_inputs + self._hidden_inputs
+        if len(leaves) != len(vis):
             raise ValueError(
-                f"model expects {len(names)} inputs, got {len(leaves)}")
-        for name, leaf in zip(names, leaves):
-            arr = np.asarray(leaf, np.float32)
-            feeds[name] = arr if batch_input else arr[None]
+                f"model expects {len(vis)} inputs, got {len(leaves)}")
+        for vi, leaf in zip(vis, leaves):
+            # honor the graph's declared input dtype: third-party
+            # graphs legitimately take int/bool feeds
+            code = vi["type"]["tensor_type"].get("elem_type", DT_FLOAT)
+            arr = np.asarray(leaf, _DTYPES.get(code, np.float32))
+            feeds[vi["name"]] = arr if batch_input else arr[None]
         results = _Runner(self._graph.get("node", []), feeds).run(
             self._outputs)
         if not batch_input:

@@ -79,6 +79,8 @@ def write_atomic(path, state):
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         pickle.dump(state, f)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
 
 

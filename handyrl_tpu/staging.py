@@ -241,30 +241,49 @@ class DeviceReplay:
     # -- buffer management -------------------------------------------
 
     def _per_slot_bytes(self, col):
-        """HBM bytes one ring slot will occupy (capacity sizing)."""
+        """HBM bytes one ring slot will occupy (capacity sizing).
+
+        Counts what the TPU actually allocates, not logical bytes: a
+        persistent ``(rows, width)`` buffer tile-pads its trailing dim
+        to 128 lanes, so every narrow per-step channel (prob, act,
+        value, reward, return, tmask, omask, turn_idx — widths 1..P)
+        costs a full 128-wide stripe.  Sizing from logical bytes here
+        would let the ring blow through ``device_replay_mb`` by >10x
+        on narrow channels — the same trap the module docstring
+        documents for obs."""
+        def lanes(width):
+            return ((max(int(width), 1) + 127) // 128) * 128
+
         P = len(col["players"])
         A = col["amask"].shape[-1]
         obs_bytes = 0
         for leaf in jax.tree.leaves(col["obs"]):
-            per_step = int(np.prod(leaf.shape[1:]))  # (T, P, ...) -> P*...
+            width = int(np.prod(leaf.shape[1:]))  # (T, P, ...) -> P*...
             item = (np.dtype(self.obs_store).itemsize
                     if np.issubdtype(leaf.dtype, np.floating)
                     else leaf.dtype.itemsize)
-            obs_bytes += per_step * item
-        step = (obs_bytes              # observation tree
-                + P * 4 * 3            # prob + value f32, act i32
-                + P * A                # amask bool
-                + P * 4 * 2            # reward, return
-                + P * 2                # tmask, omask bool
-                + 4)                   # turn_idx
-        return step * self.t_max + P * 4 + 8
+            obs_bytes += lanes(width) * item
+        step = (obs_bytes                    # observation tree
+                + lanes(P) * 4 * 3           # prob + value f32, act i32
+                + lanes(P * A)               # amask bool
+                + lanes(P) * 4 * 2           # reward, return
+                + lanes(P) * 2               # tmask, omask bool
+                + lanes(1) * 4)              # turn_idx
+        return step * self.t_max + self._slot_const_bytes(P)
+
+    @staticmethod
+    def _slot_const_bytes(P):
+        # per-slot channels: outcome (CAP, P, 1) tiles its last two
+        # dims to (8, 128); ep_len/ep_total are 1D (amortized ~0)
+        return ((P + 7) // 8) * 8 * 128 * 4 + 8
 
     def _init_buffers(self, col):
         self.num_players = len(col["players"])
         per_slot = self._per_slot_bytes(col)
         # remembered for re-clamping when T_max grows
-        self._per_step_bytes = (per_slot - self.num_players * 4 - 8) \
-            // self.t_max
+        self._per_step_bytes = (
+            per_slot - self._slot_const_bytes(self.num_players)
+        ) // self.t_max
         fit = max(64, self.max_bytes // per_slot)
         if fit < self.capacity:
             print(f"device replay: {self.capacity} episodes at "
@@ -423,7 +442,7 @@ class DeviceReplay:
         budget is re-enforced: if wider slots no longer fit, the ring
         shrinks, keeping the NEWEST episodes (FIFO semantics)."""
         old_t, cap = self.t_max, self.capacity
-        per_slot_const = self.num_players * 4 + 8
+        per_slot_const = self._slot_const_bytes(self.num_players)
         new_cap = min(cap, max(64, self.max_bytes // (
             self._per_step_bytes * new_t_max + per_slot_const)))
         print(f"device replay: growing T_max {old_t} -> {new_t_max}"
@@ -455,7 +474,9 @@ class DeviceReplay:
                 return jnp.pad(rows, pad)
             return tree_map(leaf, buf)
 
-        self.buffers = jax.jit(relayout, donate_argnums=0)(self.buffers)
+        self.buffers = jax.jit(
+            relayout, donate_argnums=0, out_shardings=self._rep
+        )(self.buffers)
         new_len = np.zeros(new_cap, np.int32)
         new_len[:kept] = self.ep_len[keep]
         self.ep_len = new_len
