@@ -396,6 +396,7 @@ class Trainer:
         self.batch_sharding = None
         self.train_mesh = None
         self.train_fsdp = False
+        self._replicate_jit = None
         self.prefetcher = None
         self.timers = SectionTimers()
         self.trace = TraceWindow(self.args.get("profile_dir") or "")
@@ -440,39 +441,51 @@ class Trainer:
 
         Multi-host: each process keeps its OWN ring over a LOCAL mesh
         of its addressable devices; the gather emits this process's
-        per-device batch shards, and ``_epoch_loop_multihost``
-        assembles them into global arrays without any cross-host data
-        movement.  Requires batch rows to divide evenly over all
-        devices; otherwise falls back to the host batcher path."""
+        per-device batch shards (rows on local dp groups, replicated
+        across the sp*tp axes inside each group), and
+        ``_epoch_loop_multihost`` assembles them into global arrays
+        without any cross-host data movement.  Works on any
+        dp/sp/tp/fsdp mesh whose dp groups are process-local;
+        otherwise falls back to the host batcher path."""
         mode = self.args.get("device_replay", "auto") or "auto"
         if self.optimizer is None or mode == "off":
             return None
         mesh = self.train_mesh
         if self.multihost:
-            # local-shard assembly is only shape-compatible with a
-            # pure-dp global mesh spanning every device: then global
-            # rows-per-device == local rows-per-device.  sp/tp meshes
-            # replicate batch rows across non-dp axes, which per-device
-            # local gathers cannot reproduce.
+            # Local-shard assembly works for ANY (dp, sp, tp[, fsdp])
+            # mesh whose dp groups are process-local.  Batch rows shard
+            # over dp and REPLICATE across sp/tp; the global mesh is
+            # jax.devices() (process-major) reshaped row-major to
+            # (dp, sp, tp), so dp coordinate d owns the `rep = sp*tp`
+            # consecutive devices [d*rep, (d+1)*rep).  When rep divides
+            # the local device count, every replication group lives on
+            # one process: the local ring gathers each dp-block of rows
+            # ONCE and lays it out replicated across that group, and
+            # global assembly is pure metadata (the rows are already on
+            # the right devices with the right replication).
+            from .parallel import multihost as mh
+
             n_local = jax.local_device_count()
             local_bs = self.local_batch_size
+            rep = 1 if mesh is None else mh.replay_group_size(mesh)
             msg = None
-            if (mesh is None
-                    or mesh.shape["sp"] != 1 or mesh.shape["tp"] != 1
-                    or mesh.size != jax.device_count()):
-                msg = ("multi-host device replay requires a pure-dp "
-                       "mesh over all devices")
-            elif local_bs % n_local != 0:
+            if mesh is None or mesh.size != jax.device_count():
+                msg = ("multi-host device replay requires a mesh over "
+                       "all devices")
+            elif n_local % rep != 0:
+                msg = (f"multi-host device replay requires each dp "
+                       f"group (sp*tp = {rep} devices) to be "
+                       f"process-local; {n_local} local devices is "
+                       f"not a multiple of {rep}")
+            elif local_bs % (n_local // rep) != 0:
                 msg = (f"device replay needs local batch {local_bs} "
-                       f"divisible by {n_local} local devices")
+                       f"divisible by {n_local // rep} local dp groups")
             if msg:
                 if mode == "on":
                     raise ValueError(msg)
                 print(msg + ": using the host batcher path")
                 return None
-            from jax.sharding import Mesh
-
-            mesh = Mesh(np.asarray(jax.local_devices()), ("dp",))
+            mesh = mh.local_replay_mesh(mesh)
         from .staging import DeviceReplay
 
         cfg = {
@@ -500,6 +513,35 @@ class Trainer:
             broadcast_train_state(
                 self.params, self.opt_state, self.steps,
                 self.data_cnt_ema))
+        if self.train_mesh is not None:
+            self._place_global_state()
+
+    def _place_global_state(self):
+        """Lay the (host-replicated) params + optimizer state out on
+        their global-mesh shardings.  Multi-process jit refuses numpy
+        arguments whose in_sharding is non-trivial (e.g. an
+        fsdp-sharded kernel), so unlike the single-host path the
+        placement must happen explicitly: every process materializes
+        its addressable shards from its identical host copy — no
+        cross-host data movement."""
+        from .parallel import param_sharding, replicated
+        from .parallel.update import opt_state_sharding
+
+        p_shard = param_sharding(self.train_mesh, self.params,
+                                 fsdp=self.train_fsdp)
+        rep = replicated(self.train_mesh)
+        o_shard = opt_state_sharding(
+            self.optimizer, self.params, p_shard, rep)
+
+        def place(tree, shards):
+            return jax.tree.map(
+                lambda a, s: jax.make_array_from_callback(
+                    np.shape(a), s,
+                    lambda idx, a=a: np.asarray(a)[idx]),
+                tree, shards)
+
+        self.params = place(self.params, p_shard)
+        self.opt_state = place(self.opt_state, o_shard)
 
     def _maybe_restore_train_state(self):
         """Resume optimizer state on restart (the reference checkpoints
@@ -537,14 +579,38 @@ class Trainer:
         self.data_cnt_ema = data_cnt_ema
         print(f"restored optimizer state at step {self.steps}")
 
-    def save_train_state(self, epoch):
+    def save_train_state(self, epoch, host_opt_state=None):
+        if host_opt_state is None:
+            host_opt_state = self._to_host(self.opt_state)
         state = {
-            "opt_state": jax.tree.map(np.asarray, self.opt_state),
+            "opt_state": host_opt_state,
             "steps": self.steps,
             "data_cnt_ema": self.data_cnt_ema,
             "epoch": epoch,
         }
         write_atomic(train_state_path(), state)
+
+    def _to_host(self, tree):
+        """Host numpy copy of a device pytree.  Leaves that shard
+        across processes (fsdp/tp on a multi-host mesh) cannot be read
+        directly; one jitted identity re-lays them out replicated
+        first — an XLA all-gather over ICI.  That makes this a
+        COLLECTIVE whenever such leaves exist: every process must call
+        it at the same point (train() does, once per epoch)."""
+        leaves = jax.tree.leaves(tree)
+        if self.multihost and self.train_mesh is not None and any(
+                not getattr(l, "is_fully_replicated", True)
+                for l in leaves):
+            if self._replicate_jit is None:
+                from .parallel import replicated
+
+                # one persistent jit: each pytree structure compiles
+                # its all-gather once, not once per epoch
+                self._replicate_jit = jax.jit(
+                    lambda t: t,
+                    out_shardings=replicated(self.train_mesh))
+            tree = self._replicate_jit(tree)
+        return jax.tree.map(np.asarray, tree)
 
     def _default_mesh_cfg(self):
         """With no mesh configured on a multi-device host, default to
@@ -690,15 +756,10 @@ class Trainer:
         """Assemble global batch arrays from this process's local
         per-device shards (device replay under multi-host).  Pure
         metadata: the shards stay where the local gather put them."""
-        n_proc = jax.process_count()
+        from .parallel import multihost as mh
 
-        def leaf(arr):
-            shards = [s.data for s in arr.addressable_shards]
-            gshape = (arr.shape[0] * n_proc,) + arr.shape[1:]
-            return jax.make_array_from_single_device_arrays(
-                gshape, self.batch_sharding, shards)
-
-        return jax.tree.map(leaf, local_batch)
+        return mh.global_from_local_shards(
+            local_batch, self.batch_sharding)
 
     def _next_multihost_batch(self):
         """One committed step's batch: device replay (local ring ->
@@ -789,9 +850,13 @@ class Trainer:
         self.opt_state = set_learning_rate(self.opt_state, lr)
 
         # snapshot: device -> host once per epoch (trainer thread owns
-        # the device buffers, so saving here cannot race a donation)
+        # the device buffers, so saving here cannot race a donation).
+        # _to_host is a collective for cross-process-sharded state, so
+        # every process computes both copies, not just process 0.
         snapshot = TPUModel(self.model.module)
-        snapshot.params = jax.tree.map(np.asarray, self.params)
+        snapshot.params = self._to_host(self.params)
+        host_opt = self._to_host(self.opt_state) if self.multihost \
+            else None
         self.last_metrics = {k: l / data_cnt for k, l in loss_sum.items()}
         for name, v in prof.items():
             self.last_metrics[f"profile_{name}_sec"] = v["sec"]
@@ -804,7 +869,7 @@ class Trainer:
         if self.primary:  # process 0 owns the (shared) checkpoint dir
             try:
                 os.makedirs(_models_dir(), exist_ok=True)
-                self.save_train_state(self.epoch)
+                self.save_train_state(self.epoch, host_opt)
             except OSError:
                 pass
         return snapshot

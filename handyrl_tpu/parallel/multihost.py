@@ -127,6 +127,51 @@ def global_batch_from_local(local_batch, sharding):
     )
 
 
+def replay_group_size(mesh) -> int:
+    """Devices per batch-replication group: batch rows shard over
+    ``dp`` and replicate across ``sp``/``tp``, and the global mesh is
+    ``jax.devices()`` (process-major) reshaped row-major to
+    (dp, sp, tp) — so each dp coordinate owns ``sp*tp`` consecutive
+    devices."""
+    return mesh.shape["sp"] * mesh.shape["tp"]
+
+
+def local_replay_mesh(mesh):
+    """Per-process ``("dp", "rep")`` mesh for a local HBM replay ring
+    under a global (dp, sp, tp) mesh.
+
+    Local devices are taken in GLOBAL enumeration order and grouped in
+    runs of ``rep = sp*tp``, so each local dp group coincides exactly
+    with a global replication group: a local gather that shards rows
+    over ``dp`` and replicates across ``rep`` lays every row out on
+    precisely the devices the GLOBAL batch sharding wants it on.
+    Caller must have checked ``local_device_count() % rep == 0``
+    (dp groups process-local)."""
+    from jax.sharding import Mesh
+
+    rep = replay_group_size(mesh)
+    local = [d for d in jax.devices()
+             if d.process_index == jax.process_index()]
+    return Mesh(np.asarray(local).reshape(len(local) // rep, rep),
+                ("dp", "rep"))
+
+
+def global_from_local_shards(local_batch, sharding):
+    """Assemble global batch arrays from per-device local shards that
+    are ALREADY laid out to match ``sharding`` (the local replay
+    gather over ``local_replay_mesh``).  Pure metadata: no device or
+    host data movement."""
+    n_proc = jax.process_count()
+
+    def leaf(arr):
+        shards = [s.data for s in arr.addressable_shards]
+        gshape = (arr.shape[0] * n_proc,) + arr.shape[1:]
+        return jax.make_array_from_single_device_arrays(
+            gshape, sharding, shards)
+
+    return jax.tree.map(leaf, local_batch)
+
+
 def sync_epoch_code(code: int) -> int:
     """All-process agreement on the epoch-control word.
 

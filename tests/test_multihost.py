@@ -19,12 +19,14 @@ from handyrl_tpu.connection import find_free_port
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 CHILD = r"""
+import json
 import sys
 import jax
 
 jax.config.update("jax_platforms", "cpu")
 pid, port = int(sys.argv[1]), int(sys.argv[2])
 device_replay = sys.argv[3]
+mesh = json.loads(sys.argv[4])
 
 args = {
     "env_args": {"env": "TicTacToe"},
@@ -51,7 +53,7 @@ args = {
         "seed": 3,
         "lockstep_episodes": 4,
         "device_replay": device_replay,
-        "mesh": {"dp": 8},
+        "mesh": mesh,
         "distributed": {
             "coordinator_address": "127.0.0.1:%d" % port,
             "num_processes": 2,
@@ -70,10 +72,22 @@ if __name__ == "__main__":  # spawn-safe: children re-import this file
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("device_replay", ["on", "off"])
-def test_two_process_learner(tmp_path, device_replay):
+@pytest.mark.parametrize("device_replay,mesh", [
+    ("on", {"dp": 8}),
+    ("off", {"dp": 8}),
+    # mixed meshes: batch rows shard over dp and replicate across
+    # tp/sp; dp groups (sp*tp consecutive devices) are process-local
+    # (4 local devices per process), so the HBM-ring feed must engage
+    # instead of degrading to the 13x-slower host batcher path
+    ("on", {"dp": 4, "tp": 2}),
+    ("on", {"dp": 4, "sp": 2, "fsdp": True}),
+])
+def test_two_process_learner(tmp_path, device_replay, mesh):
     """Both multi-host feed paths: per-process HBM rings assembled
-    into global batches (on) and the host batcher path (off)."""
+    into global batches (on) and the host batcher path (off), over
+    pure-dp and mixed dp/tp/sp/fsdp meshes."""
+    import json
+
     port = find_free_port()
     script = tmp_path / "child.py"
     script.write_text(CHILD)
@@ -86,7 +100,7 @@ def test_two_process_learner(tmp_path, device_replay):
     procs = [
         subprocess.Popen(
             [sys.executable, str(script), str(pid), str(port),
-             device_replay],
+             device_replay, json.dumps(mesh)],
             cwd=tmp_path, env=env, stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, text=True)
         for pid in range(2)
