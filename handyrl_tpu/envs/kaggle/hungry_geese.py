@@ -14,6 +14,19 @@ Observation parity (reference hungry_geese.py:206-232): 17 planes of
 7x11 — per-player head / tail-tip / whole-body / previous-head (rotated
 so the observing player is plane 0) + food — emitted channel-last
 (7, 11, 17) for TPU convs.
+
+Transition semantics follow the official ``kaggle_environments``
+interpreter (tests/test_geese_rules_golden.py pins them step by step):
+moves + eat/tail-pop first, then the every-40th-step hunger pop, then
+collision resolution on the position histogram (head-on kills all
+heads involved; pass-through swaps are legal because only the final
+histogram is consulted), reversal kills only geese with a body
+(len > 1).  Deliberate divergences, both ranking-equivalent: the
+reward step-weight is CELLS + 1 = 78 instead of the official
+max_length + 1 = 100 (any survival-step edge still dominates any
+length edge, since lengths are < 78), and food/start cells draw from
+this module's seeded ``random`` stream rather than the Kaggle
+runner's.
 """
 
 import random
@@ -87,8 +100,12 @@ class Environment(BaseEnvironment):
                 action = 0
             goose = self.geese[p]
             if (p in self.last_actions
-                    and action == OPPOSITE[self.last_actions[p]]):
-                # reversing your neck is death
+                    and action == OPPOSITE[self.last_actions[p]]
+                    and len(goose) > 1):
+                # reversing your neck is death — but a length-1 goose
+                # has no neck and may double back (official
+                # interpreter: "Check action direction on any goose
+                # with a body (longer than 1)")
                 self.statuses[p] = "DONE"
                 self.geese[p] = []
                 continue

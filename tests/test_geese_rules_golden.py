@@ -1,0 +1,173 @@
+"""Golden-trace rule validation for the native Hungry Geese.
+
+The reference delegates the game rules to the official simulator
+(/root/reference/handyrl/envs/kaggle/hungry_geese.py:67 — ``from
+kaggle_environments import make``); this repo reimplements them
+natively.  ``kaggle_environments`` is not installable here, so each
+trace below is HAND-DERIVED from the official interpreter's published
+semantics (kaggle_environments/envs/hungry_geese/hungry_geese.py):
+
+  1. per active agent, in index order: reversal check (kills only if
+     the goose has a body, ``len(goose) > 1``), insert new head, pop
+     tail unless the head landed on food (eat = grow);
+  2. hunger: every 40th step each surviving mover pops a tail
+     segment; shrinking to nothing is death;
+  3. collisions on the POSITION HISTOGRAM of all goose cells after
+     movement: any head on a cell occupied more than once dies
+     (head-on kills every head involved; pass-through swaps of
+     length-1 geese are legal because only the final histogram is
+     consulted);
+  4. rewards update for still-ACTIVE agents only, so a dying goose
+     keeps its previous step's reward = (step * step_weight + length
+     at death), making survival time dominate length in the final
+     pairwise ranking.
+
+Board addressing: cell = row * 11 + col on the 7x11 torus.
+Actions: 0 NORTH (row-1), 1 SOUTH (row+1), 2 WEST (col-1), 3 EAST.
+"""
+
+import pytest
+
+from handyrl_tpu.envs.kaggle.hungry_geese import (
+    EPISODE_STEPS,
+    HUNGER_RATE,
+    NUM_AGENTS,
+    Environment,
+)
+
+NORTH, SOUTH, WEST, EAST = 0, 1, 2, 3
+
+
+def set_state(env, geese, food=(), last_actions=None, step_count=0):
+    """Pin the full game state; dead seats are any with an empty
+    goose.  Rewards re-derive exactly as a live game would have them
+    at this point (active geese re-sync, dead geese keep 0)."""
+    env.geese = [list(g) for g in geese]
+    env.food = set(food)
+    env.statuses = ["ACTIVE" if g else "DONE" for g in geese]
+    env.rewards = [0] * NUM_AGENTS
+    env.last_actions = dict(last_actions or {})
+    env.prev_heads = [g[0] if g else None for g in geese]
+    env.step_count = step_count
+    env._sync_rewards()
+
+
+@pytest.fixture
+def env():
+    return Environment()
+
+
+def test_head_on_collision_kills_both(env):
+    # A at 0 moving EAST and B at 2 moving WEST meet head-on at 1
+    set_state(env, [[0], [2], [], []], food=[40, 50], step_count=5)
+    env.step({0: EAST, 1: WEST})
+    assert env.statuses[0] == "DONE" and env.statuses[1] == "DONE"
+    assert env.geese[0] == [] and env.geese[1] == []
+    assert env.terminal()
+    # equal length, same death step -> they tie each other and both
+    # outrank the two seats that were already dead
+    out = env.outcome()
+    assert out[0] == out[1] == pytest.approx(2 / 3)
+    assert out[2] == out[3] == pytest.approx(-2 / 3)
+
+
+def test_pass_through_swap_is_legal_for_bodiless_geese(env):
+    # adjacent length-1 geese swap cells: the official interpreter
+    # only consults the AFTER-move histogram, so no cell is occupied
+    # twice and both survive
+    set_state(env, [[1], [2], [], []], food=[40, 50], step_count=5)
+    env.step({0: EAST, 1: WEST})
+    assert env.geese[0] == [2] and env.geese[1] == [1]
+    assert env.statuses[0] == "ACTIVE" and env.statuses[1] == "ACTIVE"
+
+
+def test_swap_with_a_body_kills_the_crosser(env):
+    # A has a body: A [1,0] EAST -> {2,1}; B [2] WEST lands on 1,
+    # still occupied by A's body -> histogram count 2 -> B dies;
+    # A's head lands on 2, vacated by B -> count 1 -> A survives.
+    # (C is a far-away bystander keeping the episode alive.)
+    set_state(env, [[1, 0], [2], [60], []], food=[40, 50],
+              step_count=5)
+    env.step({0: EAST, 1: WEST, 2: WEST})
+    assert env.geese[0] == [2, 1]
+    assert env.statuses[0] == "ACTIVE"
+    assert env.statuses[1] == "DONE" and env.geese[1] == []
+
+
+def test_neck_reversal_dies_but_bodiless_reversal_lives(env):
+    # A [10, 11] came from the east (last action WEST): EAST reverses
+    # its neck -> death.  B [30] also reverses, but a length-1 goose
+    # has no neck -> legal move.
+    set_state(env, [[10, 11], [30], [66], []], food=[60, 61],
+              last_actions={0: WEST, 1: WEST}, step_count=5)
+    env.step({0: EAST, 1: EAST, 2: WEST})
+    assert env.statuses[0] == "DONE" and env.geese[0] == []
+    assert env.statuses[1] == "ACTIVE" and env.geese[1] == [31]
+
+
+def test_eat_and_starve_same_step_cancel(env):
+    # hunger fires on the transition into step 40 (native step_count
+    # 39 -> 40).  A eats on the hunger step: insert head + keep tail
+    # (grow), then hunger pops one segment -> net length unchanged,
+    # food consumed.  B (length 1, no food) starves to death.
+    step = HUNGER_RATE - 1
+    set_state(env, [[5, 4], [20], [70, 69], []], food=[6, 60],
+              last_actions={0: EAST, 1: EAST, 2: EAST},
+              step_count=step)
+    env.step({0: EAST, 1: EAST, 2: EAST})
+    assert env.geese[0] == [6, 5]
+    assert env.statuses[0] == "ACTIVE"
+    assert env.statuses[1] == "DONE" and env.geese[1] == []
+    assert env.geese[2] == [71]  # hunger shrinks the bystander too
+    assert 6 not in env.food
+    assert len(env.food) == 2  # respawned back up to MIN_FOOD
+    # control: one step earlier, eating grows and nobody starves
+    set_state(env, [[5, 4], [20], [70, 69], []], food=[6, 60],
+              last_actions={0: EAST, 1: EAST, 2: EAST},
+              step_count=step - 1)
+    env.step({0: EAST, 1: EAST, 2: EAST})
+    assert env.geese[0] == [6, 5, 4]
+    assert env.statuses[1] == "ACTIVE" and env.geese[1] == [21]
+    assert env.geese[2] == [71, 70]
+
+
+def test_simultaneous_death_ranks_by_frozen_length(env):
+    # the last two geese die head-on in the same step: both keep the
+    # PREVIOUS step's reward, where survival step ties and A's length
+    # 3 beats B's length 2 -> A first, B second, earlier deaths last
+    set_state(env, [[0, 11, 22], [2, 13], [], []], food=[40, 50],
+              step_count=8)
+    env.step({0: EAST, 1: WEST})
+    assert env.terminal()
+    assert env.rewards[0] > env.rewards[1] > 0
+    out = env.outcome()
+    assert out[0] == pytest.approx(1.0)
+    assert out[1] == pytest.approx(1 / 3)
+    assert out[2] == out[3] == pytest.approx(-2 / 3)
+
+
+def test_survival_step_dominates_length(env):
+    # B (length 1) outlives A (length 5) by one step -> B ranks
+    # higher: the step weight (78) exceeds any attainable length
+    set_state(env, [[0, 11, 22, 33, 44], [60], [], []],
+              food=[40, 50], step_count=8)
+    # A reverses into its own neck and dies; B survives the step
+    env.last_actions[0] = WEST
+    env.step({0: EAST, 1: WEST})
+    assert env.statuses[0] == "DONE"
+    assert env.statuses[1] == "DONE"  # sole survivor -> episode over
+    assert env.rewards[1] > env.rewards[0]
+    out = env.outcome()
+    assert out[1] > out[0]
+
+
+def test_episode_step_cap(env):
+    # two geese far apart idle until the 200-step cap ends the game
+    set_state(env, [[0], [60], [], []], food=[40, 50],
+              step_count=EPISODE_STEPS - 2)
+    env.step({0: EAST, 1: WEST})
+    assert env.terminal()
+    assert env.statuses[0] == "DONE" and env.statuses[1] == "DONE"
+    # both survived to the cap with equal length: a clean tie
+    out = env.outcome()
+    assert out[0] == out[1]
