@@ -4,13 +4,18 @@ reference.
 Prints ONE JSON line:
   {"metric", "value", "unit", "vs_baseline", ...extras}
 
-Headline: jitted update-step throughput on GeeseNet at batch 256 with
-bf16 compute on device-resident batches.  ``vs_baseline`` is a REAL
-ratio against the reference implementation's own update loop measured
-on this host at the SAME batch geometry by
-scripts/measure_reference_baseline.py (BASELINE_MEASURED.json — the
-reference trains one seat per simultaneous-game episode, so the true
-flagship batch is (256, 8, 1, 7, 11, 17)).
+Headline: the PRODUCTION learner path — scalar-fed device-replay fused
+step (draw + ring gather + update in one jit) on GeeseNet at batch 256
+with bf16 compute — as the MEDIAN of interleaved trials: the solo /
+device-replay / e2e sections run round-robin in one process N_TRIALS
+times, so cross-path ratios are computed pairwise within rounds and no
+number rests on a single pass (the tunnel swings +-40% between
+processes; BASELINE.md).  ``vs_baseline`` is a REAL ratio against the
+reference implementation's own update loop measured on this host at
+the SAME batch geometry by scripts/measure_reference_baseline.py
+(BASELINE_MEASURED.json — the reference trains one seat per
+simultaneous-game episode, so the true flagship batch is
+(256, 8, 1, 7, 11, 17)).
 
 Extras:
   * measured (blocked) per-step device time + MFU from it — FLOPs are
@@ -95,16 +100,18 @@ def _encode(batch, cfg):
     return out
 
 
-def measure_learner(seed, batch_size, compute_dtype, iters=30,
-                    host_iters=5, n_variants=4, timed_iters=10):
-    """Update-step steps/sec at ``batch_size``.
+def setup_learner(seed, batch_size, compute_dtype, iters=30,
+                  host_iters=5, n_variants=4, timed_iters=10):
+    """Build the update step + device-resident batch variants once.
 
-    Returns (resident_sps, host_sps, step_ms): device-resident batches
-    (the production path — batches staged in HBM by the prefetcher),
-    host-numpy batches in the production wire format (every step pays
-    the full staging + transfer), and the median blocked per-step
-    device time in ms.  Distinct batch permutations are cycled so
-    constant data cannot flatter caching.
+    Returns (trial, host_sps, step_ms): ``trial()`` times ``iters``
+    pipelined resident-batch steps and may be called repeatedly —
+    interleaved with other sections, so cross-path ratios come from
+    the same process window.  ``host_sps`` times host-numpy batches in
+    the production wire format (every step pays staging + transfer),
+    ``step_ms`` is the median blocked per-step device time.  Distinct
+    batch permutations are cycled so constant data cannot flatter
+    caching.
     """
     import jax
     import jax.numpy as jnp
@@ -138,13 +145,6 @@ def measure_learner(seed, batch_size, compute_dtype, iters=30,
     params, opt_state, metrics = update(params, opt_state, resident[0])
     float(metrics["total"])  # compile + warmup sync
 
-    t0 = time.perf_counter()
-    for i in range(iters):
-        params, opt_state, metrics = update(
-            params, opt_state, resident[i % n_variants])
-    float(metrics["total"])  # sync
-    resident_sps = iters / (time.perf_counter() - t0)
-
     # blocked per-step timing: sync every step so the number is the
     # true device latency, not dispatch pipelining
     step_ms = []
@@ -166,7 +166,33 @@ def measure_learner(seed, batch_size, compute_dtype, iters=30,
             params, opt_state, metrics = update(params, opt_state, staged)
         float(metrics["total"])  # sync
         host_sps = host_iters / (time.perf_counter() - t0)
-    return resident_sps, host_sps, median_ms
+
+    state = {"params": params, "opt_state": opt_state, "i": 0}
+
+    def trial(n=iters):
+        params, opt_state = state["params"], state["opt_state"]
+        t0 = time.perf_counter()
+        for _ in range(n):
+            i = state["i"]
+            state["i"] += 1
+            params, opt_state, metrics = update(
+                params, opt_state, resident[i % n_variants])
+        float(metrics["total"])  # sync
+        sps = n / (time.perf_counter() - t0)
+        state["params"], state["opt_state"] = params, opt_state
+        return sps
+
+    return trial, host_sps, median_ms
+
+
+def measure_learner(seed, batch_size, compute_dtype, iters=30,
+                    host_iters=5, n_variants=4, timed_iters=10):
+    """One-pass form of :func:`setup_learner` (secondary variants)."""
+    trial, host_sps, step_ms = setup_learner(
+        seed, batch_size, compute_dtype, iters=iters,
+        host_iters=host_iters, n_variants=n_variants,
+        timed_iters=timed_iters)
+    return trial(), host_sps, step_ms
 
 
 def measure_prefetch(seed, batch_size, compute_dtype, steps=40,
@@ -225,11 +251,16 @@ def measure_prefetch(seed, batch_size, compute_dtype, steps=40,
     return sps
 
 
-def measure_pipeline(seed, batch_size, compute_dtype, transfer_dtype,
-                     steps=30):
+def setup_pipeline(seed, batch_size, compute_dtype, transfer_dtype,
+                   steps=30):
     """End-to-end learner throughput: batcher processes sampling real
     episodes -> compact wire batches -> threaded device prefetch ->
-    update step.  Production training minus the actor plane."""
+    update step.  Production training minus the actor plane.
+
+    Returns (trial, stop, profile): ``trial()`` times ``steps``
+    end-to-end steps and may be called repeatedly; batchers and
+    prefetch threads stay alive between trials (they quiesce once the
+    prefetch queue refills).  Call ``stop()`` when done."""
     from collections import deque
 
     import jax
@@ -265,19 +296,29 @@ def measure_pipeline(seed, batch_size, compute_dtype, transfer_dtype,
     float(metrics["total"])  # compile + warmup
 
     timers = SectionTimers()
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        with timers.section("batch_wait"):
-            batch = prefetcher.get(timeout=120)
-        with timers.section("update"):
-            params, opt_state, metrics = update(params, opt_state, batch)
-    float(metrics["total"])  # sync
-    sps = steps / (time.perf_counter() - t0)
+    state = {"params": params, "opt_state": opt_state}
 
-    prefetcher.stop()
-    batcher.shutdown()
-    snap = timers.snapshot()
-    return sps, {name: v["sec"] for name, v in snap.items()}
+    def trial(n=steps):
+        params, opt_state = state["params"], state["opt_state"]
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with timers.section("batch_wait"):
+                batch = prefetcher.get(timeout=120)
+            with timers.section("update"):
+                params, opt_state, metrics = update(
+                    params, opt_state, batch)
+        float(metrics["total"])  # sync
+        sps = n / (time.perf_counter() - t0)
+        state["params"], state["opt_state"] = params, opt_state
+        return sps
+
+    def stop():
+        prefetcher.stop()
+        batcher.shutdown()
+
+    return (trial, stop,
+            lambda: {name: v["sec"]
+                     for name, v in timers.snapshot().items()})
 
 
 def measure_width_sweep(seed, widths=(32, 64, 128, 256),
@@ -319,12 +360,22 @@ def measure_width_sweep(seed, widths=(32, 64, 128, 256),
     return sweep
 
 
-def measure_device_replay(seed, batch_size, compute_dtype, steps=40):
-    """Device-resident replay end to end: episodes ingested into the
-    HBM ring once (amortized), then every step draws indices on the
-    host and gathers the batch ON DEVICE (the production
-    ``device_replay: auto`` learner path).  Returns (steps/sec,
-    profile split, episode ingest rate)."""
+def setup_device_replay(seed, batch_size, compute_dtype, steps=40,
+                        flood_mult=4):
+    """Device-resident replay: episodes ingested into the HBM ring
+    once (amortized), then every step draws indices, gathers the
+    batch, and updates in ONE jit fed three host scalars (the
+    production ``device_replay: auto`` learner path).
+
+    Returns (trial, profile, ingest_eps, ingest_batched_eps):
+    ``trial()`` times ``steps`` fused update steps and may be called
+    repeatedly (interleaved trials).  ``ingest_eps`` is the legacy
+    one-episode-per-dispatch ``_append`` rate; ``ingest_batched_eps``
+    is the PRODUCTION intake chain — ``offer()`` + ``ingest()``
+    draining ``flood_mult * len(episodes)`` pre-canned wire episodes
+    through the consecutive-slot ``_append_run`` batched writes
+    (decompress + pad + one device dispatch per 8 episodes), ring
+    wraps included."""
     import jax
     import jax.numpy as jnp
 
@@ -350,6 +401,17 @@ def measure_device_replay(seed, batch_size, compute_dtype, steps=40):
     jax.block_until_ready(replay.buffers)
     ingest_eps = len(episodes) / (time.perf_counter() - t0)
 
+    # production intake on the warmed ring (append jit compiled, ring
+    # at capacity so every write wraps like a steady-state run)
+    flood = [episodes[i % len(episodes)]
+             for i in range(flood_mult * len(episodes))]
+    t0 = time.perf_counter()
+    replay.offer(flood)
+    while replay.pending:
+        replay.ingest(max_episodes=64)
+    jax.block_until_ready(replay.buffers)
+    ingest_batched_eps = len(flood) / (time.perf_counter() - t0)
+
     loss_cfg = LossConfig.from_config(cfg)
     optimizer = make_optimizer(1e-3)
     params = jax.tree.map(jnp.array, model.params)
@@ -363,26 +425,35 @@ def measure_device_replay(seed, batch_size, compute_dtype, steps=40):
         replay, model, loss_cfg, optimizer, compute_dtype, batch_size,
         seed=0)
 
-    state = {"i": 0}
+    timers = SectionTimers()
+    state = {"params": params, "opt_state": opt_state,
+             "draw": replay.device_state(0)}
 
-    def one_step(params, opt_state, timers):
-        state["i"] += 1
+    def one_step(params, opt_state, draw):
         with timers.section("update"):
-            return update(params, opt_state, replay.buffers,
-                          replay.size, replay.oldest, state["i"])
+            return update(params, opt_state, replay.buffers, draw)
 
-    timers = SectionTimers()
-    params, opt_state, metrics = one_step(params, opt_state, timers)
+    params, opt_state, metrics, draw = one_step(
+        params, opt_state, state["draw"])
     float(metrics["total"])  # compile + warmup sync
+    state.update(params=params, opt_state=opt_state, draw=draw)
+    timers.snapshot()  # drop the compile/warmup section
 
-    timers = SectionTimers()
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, opt_state, metrics = one_step(params, opt_state, timers)
-    float(metrics["total"])  # sync
-    sps = steps / (time.perf_counter() - t0)
-    snap = timers.snapshot()
-    return sps, {n: v["sec"] for n, v in snap.items()}, ingest_eps
+    def trial(n=steps):
+        params, opt_state, draw = (
+            state["params"], state["opt_state"], state["draw"])
+        t0 = time.perf_counter()
+        for _ in range(n):
+            params, opt_state, metrics, draw = one_step(
+                params, opt_state, draw)
+        float(metrics["total"])  # sync
+        sps = n / (time.perf_counter() - t0)
+        state.update(params=params, opt_state=opt_state, draw=draw)
+        return sps
+
+    return (trial, lambda: {n: v["sec"]
+                            for n, v in timers.snapshot().items()},
+            ingest_eps, ingest_batched_eps)
 
 
 # ---------------------------------------------------------------------
@@ -671,6 +742,14 @@ def _run_child(flag, timeout=1200, extra=()):
     return {}
 
 
+def _median(xs):
+    s = sorted(xs)
+    return s[len(s) // 2]
+
+
+N_TRIALS = 5
+
+
 def main():
     import jax
 
@@ -681,7 +760,7 @@ def main():
     seed = seed4[:3]
     model, seed_batch, cfg = seed
 
-    sps_bf16, sps_bf16_host, step_ms = measure_learner(
+    solo_trial, sps_bf16_host, step_ms = setup_learner(
         seed, BATCH, "bfloat16")
     sps_f32, _, _ = measure_learner(seed, BATCH, "float32", iters=20,
                                     host_iters=0, timed_iters=0)
@@ -692,14 +771,38 @@ def main():
                                          iters=15, host_iters=0,
                                          timed_iters=0)
     prefetch_sps = measure_prefetch(seed, BATCH, "bfloat16")
-    e2e_sps, e2e_prof = measure_pipeline(
-        seed4, BATCH, "bfloat16", "uint8")
     try:
-        dr_sps, dr_prof, dr_ingest = measure_device_replay(
-            seed4, BATCH, "bfloat16")
+        dr_trial, dr_prof_fn, dr_ingest, dr_ingest_batched = \
+            setup_device_replay(seed4, BATCH, "bfloat16")
     except Exception as exc:  # one broken section must not kill the report
         print(f"device-replay bench failed: {exc!r}", file=sys.stderr)
-        dr_sps, dr_prof, dr_ingest = None, {"error": repr(exc)}, None
+        dr_trial, dr_ingest, dr_ingest_batched = None, None, None
+        err = repr(exc)  # 'except ... as' unbinds at block exit
+        dr_prof_fn = lambda: {"error": err}  # noqa: E731
+    e2e_trial, e2e_stop, e2e_prof_fn = setup_pipeline(
+        seed4, BATCH, "bfloat16", "uint8")
+
+    # the three learner paths as INTERLEAVED trials in one process:
+    # the tunnel swings +-40% between processes (BASELINE.md), so
+    # cross-path ratios are computed pairwise within each round and
+    # headline numbers are medians over rounds, not single passes
+    trials = {"solo": [], "device_replay": [], "e2e": []}
+    for _ in range(N_TRIALS):
+        trials["solo"].append(solo_trial())
+        if dr_trial is not None:
+            trials["device_replay"].append(dr_trial())
+        trials["e2e"].append(e2e_trial())
+        # let the prefetch queue refill before the next solo trial so
+        # batcher work doesn't bleed into another section's window
+        time.sleep(1.0)
+    e2e_stop()
+    dr_prof = dr_prof_fn()
+    e2e_prof = e2e_prof_fn()
+
+    sps_bf16 = _median(trials["solo"])
+    e2e_sps = _median(trials["e2e"])
+    dr_sps = (_median(trials["device_replay"])
+              if trials["device_replay"] else None)
 
     baseline = {}
     try:
@@ -709,9 +812,22 @@ def main():
     except OSError:
         pass
     ref256 = baseline.get(f"learner_steps_per_sec_b{BATCH}")
-    vs = sps_bf16 / ref256 if ref256 else 1.0
+    # headline = the PRODUCTION feed path (scalar-fed device replay);
+    # solo is the device-resident ceiling, kept as an extra
+    headline = dr_sps if dr_sps is not None else sps_bf16
+    vs = headline / ref256 if ref256 else 1.0
+
+    def stats(name):
+        xs = trials[name]
+        if not xs:
+            return None
+        return {"median": round(_median(xs), 2),
+                "min": round(min(xs), 2), "max": round(max(xs), 2),
+                "trials": [round(x, 2) for x in xs]}
 
     extras = {
+        "learner_trials_b256": {k: stats(k) for k in trials},
+        "learner_steps_per_sec_b256_solo": round(sps_bf16, 2),
         "learner_steps_per_sec_b256_f32": round(sps_f32, 2),
         "learner_steps_per_sec_b256_bf16_hostbatch": round(
             sps_bf16_host, 2),
@@ -725,12 +841,22 @@ def main():
         "device_replay_update_sec": dr_prof.get("update"),
         "device_replay_ingest_eps_per_sec":
             round(dr_ingest, 1) if dr_ingest is not None else None,
+        "device_replay_ingest_batched_eps_per_sec":
+            round(dr_ingest_batched, 1)
+            if dr_ingest_batched is not None else None,
         "learner_steps_per_sec_b64_bf16": round(sps64_bf16, 2),
         "learner_steps_per_sec_b1024_bf16": round(sps1024_bf16, 2),
         "reference_steps_per_sec_b256_torch_cpu": ref256,
         "reference_steps_per_sec_b64_torch_cpu":
             baseline.get("learner_steps_per_sec"),
     }
+    if trials["device_replay"]:
+        extras["device_replay_vs_solo_median"] = round(_median(
+            [r / s for r, s in zip(trials["device_replay"],
+                                   trials["solo"])]), 3)
+        extras["e2e_vs_device_replay_median"] = round(_median(
+            [e / r for e, r in zip(trials["e2e"],
+                                   trials["device_replay"])]), 3)
 
     samples, cells = batch_geometry(
         _tile(seed_batch, BATCH // SEED_EPS))
@@ -794,11 +920,16 @@ def main():
         if isinstance(extras.get(key), float):
             extras[key] = round(extras[key], 1)
 
+    path_name = ("scalar-fed device-replay fused step"
+                 if dr_sps is not None
+                 else "device-resident solo step (replay section "
+                      "failed)")
     print(json.dumps({
         "metric": "learner_update_steps_per_sec",
-        "value": round(sps_bf16, 2),
-        "unit": (f"steps/sec (GeeseNet bf16, device-resident "
-                 f"batch={BATCH}x{cfg['forward_steps']}x1p solo)"),
+        "value": round(headline, 2),
+        "unit": (f"steps/sec (GeeseNet bf16, {path_name}, "
+                 f"batch={BATCH}x{cfg['forward_steps']}x1p,"
+                 f" median of {N_TRIALS} interleaved trials)"),
         "vs_baseline": round(vs, 3),
         **extras,
     }))

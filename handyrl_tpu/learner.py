@@ -729,6 +729,7 @@ class Trainer:
         replay = self.device_replay
         cap = self.updates_cap
         batch_cnt, metric_acc = 0, []
+        state = None
         while batch_cnt == 0 or not self.update_flag:
             if self.shutdown_flag:
                 return None
@@ -741,11 +742,15 @@ class Trainer:
                 # the snapshot, releasing host CPU to the actors
                 time.sleep(0.01)
                 continue
+            if state is None or replay.state_dirty:
+                # one tiny upload per ring change; between changes the
+                # draw state lives on device and rides the jit
+                state = replay.device_state(self.steps)
             with self.timers.section("update"):
                 (self.params, self.opt_state,
-                 metrics) = self._replay_step(
+                 metrics, state) = self._replay_step(
                     self.params, self.opt_state, replay.buffers,
-                    replay.size, replay.oldest, self.steps)
+                    state)
             self.trace.tick()
             self.steps += 1
             metric_acc.append(metrics)
@@ -1050,8 +1055,11 @@ class Learner:
 
         # per-model-id outcome streams
         self.generation_stats = {}
+        self.league_stats = {}         # past epoch -> its outcomes as
+        #                                a scheduled league opponent
         self.eval_stats = {}           # model_id -> RunningScore
         self.eval_stats_by_opponent = {}  # model_id -> {name: RunningScore}
+        self.eval_stats_by_seat = {}   # model_id -> {seat: RunningScore}
         self.jobs_generated = 0
         self.jobs_evaluated = 0
         self.episodes_received = 0
@@ -1131,6 +1139,14 @@ class Learner:
                 stats = self.generation_stats.setdefault(
                     label, RunningScore())
                 stats.add(episode["outcome"][p])
+            # league seats (scheduled past-self opponents) track
+            # SEPARATELY, keyed by the snapshot epoch they played:
+            # folding them into generation_stats would collide with
+            # the label that epoch earned when it was the one training
+            for p, label in job["model_id"].items():
+                if label >= 0 and p not in job["player"]:
+                    self.league_stats.setdefault(
+                        label, RunningScore()).add(episode["outcome"][p])
         before = self.episodes_received
         self.episodes_received += len(kept)
         for mark in range(before // 100 + 1,
@@ -1149,6 +1165,7 @@ class Learner:
             if result is None:
                 continue
             job, opponent = result["args"], result["opponent"]
+            players = self.env.players()
             for p in job["player"]:
                 model_id = job["model_id"][p]
                 score = result["result"][p]
@@ -1156,6 +1173,11 @@ class Learner:
                                            ).add(score)
                 by_opp = self.eval_stats_by_opponent.setdefault(model_id, {})
                 by_opp.setdefault(opponent, RunningScore()).add(score)
+                # per-seat streams surface play-order asymmetries
+                # (e.g. a strong first seat masking a weak second)
+                by_seat = self.eval_stats_by_seat.setdefault(model_id, {})
+                by_seat.setdefault(
+                    players.index(p), RunningScore()).add(score)
 
     # -- epoch boundary ---------------------------------------------
     def _report_win_rates(self, record):
@@ -1183,6 +1205,13 @@ class Learner:
             line("total", overall)
             for name in sorted(by_opp):
                 line(name, by_opp[name])
+        by_seat = self.eval_stats_by_seat.get(self.model_epoch, {})
+        if len(by_seat) > 1:
+            print("win rate by seat = " + " ".join(
+                "%d:%.3f(%d)" % (s, by_seat[s].win_rate, by_seat[s].n)
+                for s in sorted(by_seat)))
+            for s, score in by_seat.items():
+                record[f"win_rate_seat_{s}"] = score.win_rate
 
     def _report_generation(self, record):
         stats = self.generation_stats.get(self.model_epoch)
@@ -1192,6 +1221,15 @@ class Learner:
         print("generation stats = %.3f +- %.3f" % (stats.mean, stats.std))
         record["generation_mean"] = stats.mean
         record["generation_std"] = stats.std
+        if self.league_stats:
+            # each past self's mean outcome while seated as a league
+            # opponent (negative = the current model beats it)
+            print("league stats = " + " ".join(
+                "%d:%.3f(%d)" % (e, s.mean, s.n)
+                for e, s in sorted(self.league_stats.items())))
+            record["league_opponent_mean"] = {
+                str(e): round(s.mean, 4)
+                for e, s in self.league_stats.items()}
 
     def update(self):
         print()
@@ -1283,10 +1321,33 @@ class Learner:
                     self.shutdown_flag = True
         print("finished server")
 
+    def _league_opponent(self):
+        """Sample a past checkpoint epoch for a league seat, or None.
+
+        Candidates are the epochs from the last ``past_epochs`` whose
+        snapshot file actually survives retention pruning — sampling a
+        pruned epoch would silently serve the latest model under a
+        stale label (``_serve_model``'s fallback)."""
+        cfg = self.args.get("generation_opponent") or {}
+        k = int(cfg.get("past_epochs", 0) or 0)
+        if k <= 0 or self.model_epoch < 2:
+            return None
+        if random.random() >= float(cfg.get("prob", 0.25)):
+            return None
+        lo = max(1, self.model_epoch - k)
+        cands = [e for e in range(lo, self.model_epoch)
+                 if os.path.exists(model_path(e))]
+        return random.choice(cands) if cands else None
+
     def _assign_job(self):
         """Split worker jobs between generation and evaluation so that
-        evaluation keeps pace at ``eval_rate`` of the episode stream."""
+        evaluation keeps pace at ``eval_rate`` of the episode stream.
+        With ``generation_opponent`` configured, a fraction of
+        generation jobs seat a retained past self as one opponent
+        (league-lite); those jobs carry mixed snapshots, so the actor
+        pool routes them down its sequential path."""
         players = self.env.players()
+        league_seat = past = None
         wants_eval = self.jobs_evaluated < self.eval_rate * self.jobs_generated
         if wants_eval:
             seat = self.jobs_evaluated % len(players)
@@ -1295,16 +1356,19 @@ class Learner:
             role = "e"
         else:
             trained = list(players)
+            past = self._league_opponent()
+            if past is not None:
+                league_seat = random.choice(players)
+                trained = [p for p in players if p != league_seat]
             self.jobs_generated += 1
             role = "g"
-        return {
-            "role": role,
-            "player": trained,
-            "model_id": {
-                p: self.model_epoch if p in trained else -1
-                for p in players
-            },
+        model_id = {
+            p: self.model_epoch if p in trained else -1
+            for p in players
         }
+        if league_seat is not None:
+            model_id[league_seat] = past
+        return {"role": role, "player": trained, "model_id": model_id}
 
     def _serve_model(self, model_id):
         model = self.model

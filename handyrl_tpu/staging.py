@@ -37,7 +37,9 @@ intermediate — and, critically, the persistent ring pads to the TPU's
 OOM the device (observed on Geister: a 2 GB ring became a 47 GB
 allocation).  The gather reshapes windows back to logical shapes
 in-jit, where they are transient activations XLA lays out freely.
-Per-slot channels (outcome, lengths) are ``(CAP, ...)``.
+Per-slot channels (outcome, lengths) are ``(CAP + 1, ...)``; the +1
+and an extra ``_RUN_ROUND``-row stripe past the ring are SCRATCH that
+batched-append padding scatters into and no gather ever reads.
 
 Concurrency contract: appends and samples MUST run on one thread (the
 trainer thread calls ``ingest`` between update steps).  Both jits
@@ -77,7 +79,13 @@ def make_replay_update_step(replay, model, loss_cfg, optimizer,
     core = make_update_core(model, loss_cfg, optimizer, compute_dtype)
     base_key = jax.random.PRNGKey(seed)
 
-    def step(params, opt_state, buffers, size, oldest, step_idx):
+    def step(params, opt_state, buffers, state):
+        # state = device int32 [size, oldest, step_idx]: keeping the
+        # draw scalars ON DEVICE and threading the step counter through
+        # the jit means a steady-state step uploads NOTHING — three
+        # per-step host-int uploads measurably cost ~40% throughput on
+        # tunneled hosts (BENCH r5 probe)
+        size, oldest, step_idx = state[0], state[1], state[2]
         slots, tstarts, seats = replay._draw_on_device(
             buffers, size, oldest, step_idx, base_key, batch_size)
         batch = replay._gather_batch(buffers, slots, tstarts, seats)
@@ -85,10 +93,11 @@ def make_replay_update_step(replay, model, loss_cfg, optimizer,
             batch = jax.tree.map(
                 lambda x: jax.lax.with_sharding_constraint(
                     x, replay._out), batch)
-        return core(params, opt_state, batch)
+        p, o, metrics = core(params, opt_state, batch)
+        return p, o, metrics, state + jnp.asarray([0, 0, 1], jnp.int32)
 
     if mesh is None:
-        return jax.jit(step, donate_argnums=(0, 1))
+        return jax.jit(step, donate_argnums=(0, 1, 3))
 
     from .parallel.mesh import param_sharding, replicated
     from .parallel.update import opt_state_sharding
@@ -98,12 +107,19 @@ def make_replay_update_step(replay, model, loss_cfg, optimizer,
     o_shard = opt_state_sharding(optimizer, params, p_shard, rep)
     return jax.jit(
         step,
-        in_shardings=(p_shard, o_shard, rep, None, None, None),
-        out_shardings=(p_shard, o_shard, rep),
-        donate_argnums=(0, 1),
+        in_shardings=(p_shard, o_shard, rep, rep),
+        out_shardings=(p_shard, o_shard, rep, rep),
+        donate_argnums=(0, 1, 3),
     )
 
 _GROW_ROUND = 32   # T_max granularity; growth doubles => few recompiles
+# episode uploads pad to _GROW_ROUND-row buckets (not full t_max
+# stripes: ~6x less wire traffic at real episode-length spreads) and
+# each append batch pads its TOTAL rows to _RUN_ROUND so the scatter
+# jit sees a handful of shapes; padding rows land in a scratch stripe
+# past the ring that no gather ever reads
+_RUN_ROUND = 256
+_MAX_RUN = 8       # per-slot scatter width (ingest batch cap)
 _PER_SLOT = ("outcome", "ep_len", "ep_total")
 
 
@@ -184,6 +200,25 @@ class DeviceReplay:
         self.pending_cap = 512
         self.dropped = 0
         self._lock = threading.Lock()
+        self._state_dirty = True   # ring changed since last device_state
+
+    def device_state(self, step_idx):
+        """Device int32 ``[size, oldest, step_idx]`` for the fused
+        update step (make_replay_update_step).  Uploaded once here and
+        then THREADED through the jit (which returns it with the step
+        counter advanced), so steady-state steps upload nothing; call
+        again only when ``state_dirty`` says an append/growth moved
+        the ring."""
+        self._state_dirty = False
+        arr = jnp.asarray(
+            np.asarray([self.size, self.oldest, step_idx], np.int32))
+        if self._rep is not None:
+            arr = jax.device_put(arr, self._rep)
+        return arr
+
+    @property
+    def state_dirty(self):
+        return self._state_dirty
 
     # -- ingest -------------------------------------------------------
 
@@ -197,14 +232,15 @@ class DeviceReplay:
                 self.pending.popleft()
                 self.dropped += 1
 
-    def ingest(self, max_episodes=64, batch=8):
+    def ingest(self, max_episodes=64, batch=_MAX_RUN):
         """Trainer-thread only: move pending episodes into the device
         ring.  Bounded per call so one call can't stall an update.
 
-        Episodes fill CONSECUTIVE ring slots, so up to ``batch`` of
-        them upload as ONE device write (a single dynamic-update-slice
-        of ``k * t_max`` rows) — per-dispatch latency, not bandwidth,
-        dominates small uploads, especially through tunneled hosts."""
+        Up to ``batch`` episodes upload as ONE device scatter —
+        per-dispatch latency, not bandwidth, dominates small uploads,
+        especially through tunneled hosts — and each episode ships
+        only its bucket-rounded length, not a full t_max stripe."""
+        batch = min(batch, _MAX_RUN)
         if self.buffers is None:
             # size T_max from everything already waiting (the warmup
             # backlog usually contains a near-maximal episode, saving
@@ -228,15 +264,15 @@ class DeviceReplay:
             if self.buffers is None:
                 self._append(cols.pop(0))  # sizes + allocates buffers
             while cols:
-                # one write per run of consecutive slots (the ring may
-                # wrap, and a long episode may force growth first)
-                k = min(len(cols), self.capacity - self.write_ptr)
-                run = cols[:k]
-                if any(len(c["turn_idx"]) > self.t_max for c in run):
+                if any(len(c["turn_idx"]) > self.t_max for c in cols):
                     self._append(cols.pop(0))  # grows, then resume
                     continue
+                # never more episodes than ring slots in one scatter:
+                # repeated slot indices would mix trajectories
+                # (undefined duplicate-index winner)
+                run = cols[:self.capacity]
                 self._append_run(run)
-                del cols[:k]
+                del cols[:len(run)]
 
     # -- buffer management -------------------------------------------
 
@@ -284,16 +320,22 @@ class DeviceReplay:
         self._per_step_bytes = (
             per_slot - self._slot_const_bytes(self.num_players)
         ) // self.t_max
-        fit = max(64, self.max_bytes // per_slot)
+        # the budget is a hard ceiling — flooring it away would OOM at
+        # exactly the episode sizes (GRF-scale) where it matters most
+        fit = max(1, self.max_bytes // per_slot)
         if fit < self.capacity:
             print(f"device replay: {self.capacity} episodes at "
                   f"~{per_slot/1e6:.2f} MB each exceed the "
                   f"{self.max_bytes >> 20} MiB budget; ring capped at "
-                  f"{fit} (raise device_replay_mb to widen)")
+                  f"{fit} (raise device_replay_mb to widen)"
+                  + (" — WARNING: a ring this small cripples replay "
+                     "diversity" if fit < 64 else ""))
             self.capacity = int(fit)
         P = self.num_players
         A = col["amask"].shape[-1]
-        flat = self.capacity * self.t_max
+        # + one scratch stripe past the ring (and one scratch slot)
+        # where batched-append PADDING rows land; gathers never read it
+        flat = self.capacity * self.t_max + _RUN_ROUND
         z = jnp.zeros
         # logical per-step shapes; stored flattened to 2D (see module
         # docstring: TPU tile padding on small trailing dims)
@@ -326,9 +368,9 @@ class DeviceReplay:
             "tmask": flat2d((P, 1), jnp.bool_),
             "omask": flat2d((P, 1), jnp.bool_),
             "turn_idx": flat2d((), jnp.int32),
-            "outcome": z((self.capacity, P, 1), jnp.float32),
-            "ep_len": z((self.capacity,), jnp.int32),
-            "ep_total": z((self.capacity,), jnp.int32),
+            "outcome": z((self.capacity + 1, P, 1), jnp.float32),
+            "ep_len": z((self.capacity + 1,), jnp.int32),
+            "ep_total": z((self.capacity + 1,), jnp.int32),
         }
         if self._rep is not None:
             self.buffers = jax.device_put(self.buffers, self._rep)
@@ -336,17 +378,17 @@ class DeviceReplay:
         self._build_jits()
 
     def _build_jits(self):
-        t_max = self.t_max
-
-        def append(buffers, ep, slot):
-            base = slot * t_max
+        def append(buffers, ep, flat_idx, slots):
+            # scatter write: per-step channels land at explicit flat
+            # row indices (bucket-rounded episode rows + scratch-bound
+            # padding), per-slot channels at their slot indices.  One
+            # dispatch per ingest batch; shapes bucket to _RUN_ROUND
+            # totals so the jit compiles a handful of variants.
             out = {}
             for key, buf in buffers.items():
-                offset = slot if key in _PER_SLOT else base
+                idx = slots if key in _PER_SLOT else flat_idx
                 out[key] = jax.tree.map(
-                    lambda b, e, o=offset:
-                        jax.lax.dynamic_update_slice_in_dim(
-                            b, e, o, axis=0),
+                    lambda b, e, i=idx: b.at[i].set(e),
                     buf, ep[key])
             return out
 
@@ -359,11 +401,12 @@ class DeviceReplay:
             self._append_fn = jax.jit(append, donate_argnums=0)
             self._sample_fn = jax.jit(self._gather_batch)
 
-    def _pad_episode(self, col):
-        """Columnar episode -> fixed (t_max, ...) host arrays in the
-        storage dtypes."""
+    def _pad_episode(self, col, rows):
+        """Columnar episode -> (rows, ...) host arrays in the storage
+        dtypes (``rows`` is the episode's bucket-rounded length, NOT
+        t_max: short episodes must not ship full stripes)."""
         T = len(col["turn_idx"])
-        pad = self.t_max - T
+        pad = rows - T
 
         def padt(a, value=0):
             a = np.ascontiguousarray(a).reshape(T, -1)  # 2D storage
@@ -401,23 +444,55 @@ class DeviceReplay:
         }
 
     def _append_run(self, cols):
-        """Write ``len(cols)`` episodes into consecutive slots with ONE
-        device dispatch.  Callers guarantee: buffers exist, no episode
-        exceeds t_max, and the run fits before the ring wraps."""
-        if len(cols) == 1:
-            return self._append(cols[0])
-        eps = [self._pad_episode(c) for c in cols]
-        ep = {key: jax.tree.map(
-            lambda *arrs: np.concatenate(arrs),
-            *[e[key] for e in eps]) for key in eps[0]}
-        slot = self.write_ptr
-        self.buffers = self._append_fn(self.buffers, ep, slot)
-        for i, col in enumerate(cols):
-            self.ep_len[slot + i] = len(col["turn_idx"])
+        """Write ``len(cols) <= _MAX_RUN`` episodes with ONE device
+        scatter.  Each episode ships its bucket-rounded rows; the
+        batch's total rows pad to _RUN_ROUND (padding rows scatter
+        into the scratch stripe past the ring, per-slot padding into
+        the scratch slot) so the jit sees few shapes.  Callers
+        guarantee buffers exist and no episode exceeds t_max; slot
+        wrap-around needs no special casing — indices are explicit."""
         k = len(cols)
-        self.write_ptr = (slot + k) % self.capacity
+        lens = [len(c["turn_idx"]) for c in cols]
+        rows = [_round_up(t) for t in lens]
+        eps = [self._pad_episode(c, r) for c, r in zip(cols, rows)]
+        slots = [(self.write_ptr + i) % self.capacity
+                 for i in range(k)]
+        total = sum(rows)
+        pad = -total % _RUN_ROUND
+        scratch = self.capacity * self.t_max
+        flat_idx = np.concatenate(
+            [s * self.t_max + np.arange(r, dtype=np.int32)
+             for s, r in zip(slots, rows)]
+            + ([scratch + np.arange(pad, dtype=np.int32)]
+               if pad else []))
+        slot_idx = np.asarray(
+            slots + [self.capacity] * (_MAX_RUN - k), np.int32)
+
+        def cat_steps(*arrs):
+            out = np.concatenate(arrs)
+            if pad:
+                out = np.concatenate(
+                    [out, np.zeros((pad,) + out.shape[1:], out.dtype)])
+            return out
+
+        def cat_slots(*arrs):
+            out = np.concatenate(arrs)
+            if k < _MAX_RUN:
+                out = np.concatenate([out, np.zeros(
+                    (_MAX_RUN - k,) + out.shape[1:], out.dtype)])
+            return out
+
+        ep = {key: jax.tree.map(
+            cat_slots if key in _PER_SLOT else cat_steps,
+            *[e[key] for e in eps]) for key in eps[0]}
+        self.buffers = self._append_fn(
+            self.buffers, ep, flat_idx, slot_idx)
+        for s, t in zip(slots, lens):
+            self.ep_len[s] = t
+        self.write_ptr = (self.write_ptr + k) % self.capacity
         self.size = min(self.size + k, self.capacity)
         self.episodes_seen += k
+        self._state_dirty = True
 
     def _append(self, col):
         T = len(col["turn_idx"])
@@ -427,13 +502,7 @@ class DeviceReplay:
             self._init_buffers(col)
         if T > self.t_max:
             self._grow(_round_up(max(T, self.t_max * 2)))
-        ep = self._pad_episode(col)
-        slot = self.write_ptr
-        self.buffers = self._append_fn(self.buffers, ep, slot)
-        self.ep_len[slot] = T
-        self.write_ptr = (self.write_ptr + 1) % self.capacity
-        self.size = min(self.size + 1, self.capacity)
-        self.episodes_seen += 1
+        self._append_run([col])
 
     def _grow(self, new_t_max):
         """A longer episode than ever seen arrived: re-lay the ring
@@ -443,7 +512,7 @@ class DeviceReplay:
         shrinks, keeping the NEWEST episodes (FIFO semantics)."""
         old_t, cap = self.t_max, self.capacity
         per_slot_const = self._slot_const_bytes(self.num_players)
-        new_cap = min(cap, max(64, self.max_bytes // (
+        new_cap = min(cap, max(1, self.max_bytes // (
             self._per_step_bytes * new_t_max + per_slot_const)))
         print(f"device replay: growing T_max {old_t} -> {new_t_max}"
               + (f", ring {cap} -> {new_cap} (byte budget)"
@@ -461,16 +530,19 @@ class DeviceReplay:
 
         def relayout(buf):
             def leaf(a):
-                if a.shape[0] == cap * old_t:
+                if a.shape[0] == cap * old_t + _RUN_ROUND:
                     rows = a[flat_keep].reshape(
                         (kept, old_t) + a.shape[1:])
                     pad = [(0, new_cap - kept), (0, new_t_max - old_t)
                            ] + [(0, 0)] * (a.ndim - 1)
-                    return jnp.pad(rows, pad).reshape(
+                    flat = jnp.pad(rows, pad).reshape(
                         (new_cap * new_t_max,) + a.shape[1:])
-                # per-slot channel
+                    # fresh scratch stripe past the new ring
+                    return jnp.pad(
+                        flat, [(0, _RUN_ROUND)] + [(0, 0)] * (a.ndim - 1))
+                # per-slot channel (+ its scratch slot)
                 rows = a[keep]
-                pad = [(0, new_cap - kept)] + [(0, 0)] * (a.ndim - 1)
+                pad = [(0, new_cap + 1 - kept)] + [(0, 0)] * (a.ndim - 1)
                 return jnp.pad(rows, pad)
             return tree_map(leaf, buf)
 
@@ -484,6 +556,7 @@ class DeviceReplay:
         self.write_ptr = kept % new_cap
         self.capacity = new_cap
         self.t_max = new_t_max
+        self._state_dirty = True
         self._build_jits()
 
     # -- sampling -----------------------------------------------------
