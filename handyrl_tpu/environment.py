@@ -24,6 +24,7 @@ ENV_REGISTRY = {
     "ParallelTicTacToe": "handyrl_tpu.envs.parallel_tictactoe",
     "Geister": "handyrl_tpu.envs.geister",
     "HungryGeese": "handyrl_tpu.envs.kaggle.hungry_geese",
+    "GRFProxy": "handyrl_tpu.envs.grf_proxy",
 }
 
 

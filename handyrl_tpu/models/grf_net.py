@@ -1,0 +1,54 @@
+"""Recurrent policy-value net for GRF-scale observations.
+
+Capability target: BASELINE.json config #5 — "Google Research
+Football, LSTM policy, large-scale distributed workers".  The GRF env
+itself cannot ship here (SURVEY §2.2: the snapshot lacks it and the
+package is not installable), so this net serves the GRFProxy drill
+env at the REAL GRF geometry: (72, 96, 16) SMM-sized observation
+planes, orders of magnitude more pixels than the 7x11/6x6 board nets.
+
+TPU-first shape strategy: two stride-2 conv stages shrink 72x96 to
+18x24 BEFORE the recurrent core, so the carried ConvLSTM state is
+(18, 24, F) — 16x smaller in HBM and wire bytes than full-resolution
+state, and the heavy convs run once per step at full rate on the MXU.
+"""
+
+from flax import linen as nn
+
+from .blocks import PolicyHead, ValueHead, pick_num_groups
+from .recurrent import DRC
+
+FIELD = (72, 96)
+CORE = (18, 24)          # field / 4 after the strided stem
+NUM_ACTIONS = 9          # 8 directions + stay
+
+
+class GRFNet(nn.Module):
+    filters: int = 32
+    drc_layers: int = 1
+    drc_repeats: int = 2
+
+    def init_hidden(self, batch_shape=()):
+        return DRC.initial_state(
+            self.drc_layers, CORE, self.filters, batch_shape)
+
+    @nn.compact
+    def __call__(self, obs, hidden):
+        x = obs["board"] if isinstance(obs, dict) else obs
+        if hidden is None:
+            hidden = self.init_hidden((x.shape[0],))
+        for _ in range(2):  # (72,96) -> (36,48) -> (18,24)
+            x = nn.Conv(self.filters, (3, 3), strides=(2, 2),
+                        padding="SAME", use_bias=False)(x)
+            x = nn.GroupNorm(
+                num_groups=pick_num_groups(self.filters))(x)
+            x = nn.relu(x)
+        x, new_hidden = DRC(
+            self.drc_layers, self.filters,
+            num_repeats=self.drc_repeats)(x, hidden)
+        return {
+            "policy": PolicyHead(
+                bottleneck=2, num_actions=NUM_ACTIONS)(x),
+            "value": ValueHead(bottleneck=2)(x),
+            "hidden": new_hidden,
+        }

@@ -17,6 +17,7 @@ ENVS = [
     "handyrl_tpu.envs.parallel_tictactoe",
     "handyrl_tpu.envs.geister",
     "handyrl_tpu.envs.kaggle.hungry_geese",
+    "handyrl_tpu.envs.grf_proxy",
 ]
 
 
