@@ -229,6 +229,7 @@ class Gather(QueueCommunicator):
 
     CACHED_VERBS = ("model",)
     CACHE_CAPACITY = 4  # per verb; epochs advance, so old keys go cold
+    FLUSH_AGE = 0.5  # seconds an upload may wait for batch-mates
 
     def __init__(self, args, conn, gather_id):
         print(f"started gather {gather_id}")
@@ -239,6 +240,7 @@ class Gather(QueueCommunicator):
             verb: OrderedDict() for verb in self.CACHED_VERBS}
         self.pending_uploads = {}
         self.pending_count = 0
+        self.first_pending_t = 0.0
 
         worker_conns = self._spawn_workers(args, gather_id)
         super().__init__(worker_conns)
@@ -280,6 +282,8 @@ class Gather(QueueCommunicator):
 
     def _stage_upload(self, conn, verb, payload):
         self.send(conn, None)  # ack now, ship later
+        if self.pending_count == 0:
+            self.first_pending_t = time.perf_counter()
         self.pending_uploads.setdefault(verb, []).append(payload)
         self.pending_count += 1
         if self.pending_count >= self.block_size:
@@ -291,11 +295,22 @@ class Gather(QueueCommunicator):
         self.pending_uploads = {}
         self.pending_count = 0
 
+    def _flush_if_stale(self):
+        """Age-based flush: at low episode rates (big envs, few
+        workers per gather) a finished episode must not sit behind the
+        count trigger indefinitely — ship whatever is pending once the
+        oldest upload has waited FLUSH_AGE."""
+        if (self.pending_count
+                and time.perf_counter() - self.first_pending_t
+                >= self.FLUSH_AGE):
+            self.flush_uploads()
+
     def run(self):
         while self.connection_count() > 0:
             try:
                 conn, (verb, payload) = self.recv(timeout=0.3)
             except queue.Empty:
+                self._flush_if_stale()
                 continue
             if verb == "args":
                 self._serve_job(conn)
@@ -303,6 +318,7 @@ class Gather(QueueCommunicator):
                 self._serve_cached(conn, verb, payload)
             else:
                 self._stage_upload(conn, verb, payload)
+            self._flush_if_stale()
         if self.pending_count:
             self.flush_uploads()  # don't drop episodes at shutdown
 

@@ -96,3 +96,28 @@ def test_columnar_cache_is_byte_bounded():
         batch_mod._columnar_block(make_blob(i))
         assert batch_mod._col_cache_bytes <= cap
     assert len(batch_mod._COL_CACHE) < n  # eviction actually happened
+
+
+def test_gather_time_based_flush():
+    """A trickling upload must not sit behind the count trigger: once
+    the oldest pending upload ages past FLUSH_AGE, the next loop
+    iteration ships it even though the block is not full."""
+    import time
+
+    gather = Gather.__new__(Gather)  # no workers: test staging alone
+    conn = _Conn(reply=None)
+    gather.learner_conn = conn
+    gather.pending_uploads = {}
+    gather.pending_count = 0
+    gather.first_pending_t = 0.0
+    gather.block_size = 5
+    gather.send = lambda c, payload: None
+
+    gather._stage_upload(None, "episode", {"steps": 3})
+    assert conn.requests == []           # below the count trigger
+    gather._flush_if_stale()
+    assert conn.requests == []           # fresh: still batching
+    gather.first_pending_t = time.perf_counter() - Gather.FLUSH_AGE
+    gather._flush_if_stale()
+    assert conn.requests == [("episode", [{"steps": 3}])]
+    assert gather.pending_count == 0 and gather.pending_uploads == {}
