@@ -121,6 +121,16 @@ class TrainConfig:
     checkpoint_keep_last: int = 0
     # ... plus every K-th epoch regardless of age (0 = none)
     checkpoint_keep_every: int = 0
+    # league-lite: schedule PAST-SELF opponents into generation jobs.
+    # {past_epochs: K} samples one opponent seat per league job from
+    # the retained checkpoints of the last K epochs; optional prob
+    # (default 0.25) is the fraction of generation jobs that become
+    # league jobs.  Empty = off (pure self-play, the reference
+    # behavior).  League episodes fall back to the sequential actor
+    # path (the lockstep pool shares one snapshot) and train with
+    # exact importance weights — the recorded behavior probs are the
+    # past policy's.
+    generation_opponent: Dict[str, Any] = field(default_factory=dict)
 
     def __post_init__(self):
         if self.policy_target not in POLICY_TARGETS:
@@ -147,6 +157,21 @@ class TrainConfig:
         if self.device_replay not in ("auto", "on", "off"):
             raise ValueError(
                 f"unknown device_replay {self.device_replay!r}")
+        if self.generation_opponent:
+            unknown = set(self.generation_opponent) - {
+                "past_epochs", "prob"}
+            if unknown:
+                raise ValueError(
+                    f"unknown generation_opponent keys: "
+                    f"{sorted(unknown)}")
+            if int(self.generation_opponent.get(
+                    "past_epochs", 0)) < 1:
+                raise ValueError(
+                    "generation_opponent.past_epochs must be >= 1")
+            prob = float(self.generation_opponent.get("prob", 0.25))
+            if not 0.0 < prob <= 1.0:
+                raise ValueError(
+                    "generation_opponent.prob must be in (0, 1]")
 
     # The reference floors the eval rate so at least ~n^0.85 of every
     # update window is evaluation (/root/reference/handyrl/train.py:415).

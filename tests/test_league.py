@@ -1,0 +1,110 @@
+"""League-lite: past-self opponents scheduled into generation jobs.
+
+The by-id model serving, worker ModelCache LRU, and the pool's
+sequential fallback for mixed-snapshot jobs all predate this; what the
+``generation_opponent`` config adds is a SCHEDULER that actually
+assigns old epochs, plus honest per-epoch stats for them (capability
+beyond the reference, which built by-id serving but never a league —
+/root/reference/handyrl/train.py:604-614)."""
+
+import os
+import random
+from collections import deque
+
+import pytest
+
+from handyrl_tpu.environment import make_env
+from handyrl_tpu.learner import Learner, model_path
+
+
+def _stub_learner(tmp_path, monkeypatch, epochs_on_disk=(3, 4)):
+    monkeypatch.chdir(tmp_path)
+    os.makedirs("models", exist_ok=True)
+    for e in epochs_on_disk:
+        with open(model_path(e), "wb") as f:
+            f.write(b"snapshot")
+    lrn = Learner.__new__(Learner)
+    lrn.args = {"generation_opponent": {"past_epochs": 3, "prob": 1.0}}
+    lrn.env = make_env({"env": "TicTacToe"})
+    lrn.model_epoch = 5
+    lrn.eval_rate = 0.0
+    lrn.jobs_generated = 1
+    lrn.jobs_evaluated = 1
+    return lrn
+
+
+def test_league_jobs_seat_retained_past_epochs(tmp_path, monkeypatch):
+    lrn = _stub_learner(tmp_path, monkeypatch)
+    random.seed(0)
+    seen_past = set()
+    for _ in range(30):
+        job = lrn._assign_job()
+        assert job["role"] == "g"
+        # exactly one league seat, holding a PAST epoch that survives
+        # on disk (epoch 2 is inside past_epochs range but pruned, so
+        # it must never be scheduled)
+        opp_ids = [mid for p, mid in job["model_id"].items()
+                   if p not in job["player"]]
+        assert len(opp_ids) == 1
+        assert opp_ids[0] in (3, 4)
+        seen_past.add(opp_ids[0])
+        # remaining seats train on the current epoch
+        assert {job["model_id"][p] for p in job["player"]} == {5}
+    assert seen_past == {3, 4}  # both retained epochs get sampled
+
+
+def test_league_off_and_cold_start_fall_back_to_self_play(
+        tmp_path, monkeypatch):
+    lrn = _stub_learner(tmp_path, monkeypatch)
+    lrn.args = {}  # league off: every generation job is pure self-play
+    job = lrn._assign_job()
+    assert set(job["player"]) == set(lrn.env.players())
+    assert set(job["model_id"].values()) == {5}
+    # league on but no retained checkpoints yet -> self-play
+    lrn.args = {"generation_opponent": {"past_epochs": 3, "prob": 1.0}}
+    for e in (3, 4):
+        os.remove(model_path(e))
+    job = lrn._assign_job()
+    assert set(job["player"]) == set(lrn.env.players())
+
+
+def test_league_outcomes_keyed_by_past_epoch(tmp_path, monkeypatch):
+    lrn = _stub_learner(tmp_path, monkeypatch)
+    random.seed(1)
+    job = lrn._assign_job()
+    opp = next(p for p in job["model_id"] if p not in job["player"])
+    past_label = job["model_id"][opp]
+
+    lrn.generation_stats, lrn.league_stats = {}, {}
+    lrn.episodes_received = 0
+    lrn.trainer = type("T", (), {"device_replay": None})()
+    lrn.replay = deque()
+    episode = {
+        "args": job,
+        "outcome": {p: (1.0 if p in job["player"] else -1.0)
+                    for p in job["model_id"]},
+        "final_model_epoch": 5,
+        "steps": 9,
+    }
+    lrn.feed_episodes([episode])
+    # the past self's outcome lands under ITS epoch in league_stats,
+    # never polluting the label it earned while training
+    assert lrn.league_stats[past_label].n == 1
+    assert lrn.league_stats[past_label].mean == pytest.approx(
+        -1.0, abs=1e-3)
+    assert past_label not in lrn.generation_stats
+    assert lrn.generation_stats[5].n == 1
+    assert lrn.generation_stats[5].mean == pytest.approx(1.0, abs=1e-3)
+
+
+def test_generation_opponent_config_validation():
+    from handyrl_tpu.config import TrainConfig
+
+    with pytest.raises(ValueError):
+        TrainConfig(generation_opponent={"past_epochs": 0})
+    with pytest.raises(ValueError):
+        TrainConfig(generation_opponent={"past_epochs": 3, "prob": 0.0})
+    with pytest.raises(ValueError):
+        TrainConfig(generation_opponent={"bogus": 1})
+    TrainConfig(generation_opponent={"past_epochs": 8, "prob": 0.5})
+    TrainConfig()  # default off
