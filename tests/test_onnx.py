@@ -167,3 +167,149 @@ def test_runner_executes_foreign_style_graph():
     r = np.maximum(c, 0)
     expect = r.reshape(1, -1) @ dense
     np.testing.assert_allclose(out["policy"], expect[0], rtol=1e-5)
+
+
+def _torch_idiom_ttt_graph(tmp_path):
+    """A full policy-value TicTacToe net in torch-export idiom —
+    Transpose to NCHW, Conv, BatchNormalization, Relu, Reshape via an
+    int64 shape initializer, transB Gemm heads, Tanh value — built
+    node by node with onnx_proto.encode, NOT by onnx_export."""
+    from handyrl_tpu.interop.onnx_export import (
+        _attr,
+        _value_info,
+        numpy_to_tensor,
+    )
+    from handyrl_tpu.interop.onnx_proto import encode
+
+    rng = np.random.default_rng(11)
+    conv_w = rng.normal(size=(8, 3, 3, 3)).astype(np.float32) * 0.3
+    conv_b = rng.normal(size=(8,)).astype(np.float32) * 0.1
+    bn_scale = rng.uniform(0.5, 1.5, 8).astype(np.float32)
+    bn_bias = rng.normal(size=(8,)).astype(np.float32) * 0.1
+    bn_mean = rng.normal(size=(8,)).astype(np.float32) * 0.1
+    bn_var = rng.uniform(0.5, 1.5, 8).astype(np.float32)
+    pol_w = rng.normal(size=(9, 72)).astype(np.float32) * 0.2
+    pol_b = rng.normal(size=(9,)).astype(np.float32) * 0.1
+    val_w = rng.normal(size=(1, 72)).astype(np.float32) * 0.2
+    val_b = np.zeros(1, np.float32)
+
+    def node(op, inputs, outputs, **attrs):
+        return {"op_type": op, "input": inputs, "output": outputs,
+                "attribute": [_attr(k, v) for k, v in attrs.items()]}
+
+    graph = {
+        "name": "third_party_ttt",
+        "node": [
+            node("Transpose", ["input"], ["nchw"], perm=[0, 3, 1, 2]),
+            node("Conv", ["nchw", "conv_w", "conv_b"], ["c"],
+                 pads=[1, 1, 1, 1], strides=[1, 1]),
+            node("BatchNormalization",
+                 ["c", "bn_scale", "bn_bias", "bn_mean", "bn_var"],
+                 ["n"], epsilon=1e-5),
+            node("Relu", ["n"], ["r"]),
+            node("Reshape", ["r", "flat_shape"], ["f"]),
+            node("Gemm", ["f", "pol_w", "pol_b"], ["policy"],
+                 transB=1),
+            node("Gemm", ["f", "val_w", "val_b"], ["v_raw"],
+                 transB=1),
+            node("Tanh", ["v_raw"], ["value"]),
+        ],
+        "initializer": [
+            numpy_to_tensor(a, n) for a, n in [
+                (conv_w, "conv_w"), (conv_b, "conv_b"),
+                (bn_scale, "bn_scale"), (bn_bias, "bn_bias"),
+                (bn_mean, "bn_mean"), (bn_var, "bn_var"),
+                (pol_w, "pol_w"), (pol_b, "pol_b"),
+                (val_w, "val_w"), (val_b, "val_b"),
+                (np.asarray([1, 72], np.int64), "flat_shape")]
+        ],
+        "input": [_value_info("input", (1, 3, 3, 3))],
+        "output": [_value_info("policy", (1, 9)),
+                   _value_info("value", (1, 1))],
+    }
+    blob = encode({"ir_version": 8, "graph": graph,
+                   "opset_import": [{"domain": "", "version": 13}]},
+                  "Model")
+    path = str(tmp_path / "third_party.onnx")
+    with open(path, "wb") as f:
+        f.write(blob)
+    weights = dict(conv_w=conv_w, conv_b=conv_b, bn_scale=bn_scale,
+                   bn_bias=bn_bias, bn_mean=bn_mean, bn_var=bn_var,
+                   pol_w=pol_w, pol_b=pol_b, val_w=val_w, val_b=val_b)
+    return path, weights
+
+
+def test_third_party_graph(tmp_path):
+    """A graph this repo did NOT produce plays full matches through
+    the --eval model slot (the reference accepts any
+    onnxruntime-supported graph: evaluation.py:287-365)."""
+    from handyrl_tpu.agent import Agent, RandomAgent
+    from handyrl_tpu.environment import make_env
+    from handyrl_tpu.evaluation import exec_match, load_model
+
+    path, w = _torch_idiom_ttt_graph(tmp_path)
+    env = make_env({"env": "TicTacToe"})
+    env.reset()
+    loaded = load_model(path, env)  # the --eval entry point
+
+    # numbers first: independently recompute the forward in numpy
+    # from the raw weights (NHWC -> NCHW by hand, explicit BN algebra)
+    obs = env.observation(env.players()[0]).astype(np.float32)
+    x = obs.transpose(2, 0, 1)[None]
+    xp = np.pad(x, [(0, 0), (0, 0), (1, 1), (1, 1)])
+    c = np.empty((1, 8, 3, 3), np.float32)
+    for o in range(8):
+        acc = np.zeros((3, 3), np.float32)
+        for ci in range(3):
+            for kh in range(3):
+                for kw in range(3):
+                    acc += (w["conv_w"][o, ci, kh, kw]
+                            * xp[0, ci, kh:kh + 3, kw:kw + 3])
+        c[0, o] = acc + w["conv_b"][o]
+    n = ((c - w["bn_mean"].reshape(1, -1, 1, 1))
+         / np.sqrt(w["bn_var"].reshape(1, -1, 1, 1) + 1e-5)
+         * w["bn_scale"].reshape(1, -1, 1, 1)
+         + w["bn_bias"].reshape(1, -1, 1, 1))
+    f = np.maximum(n, 0).reshape(1, -1)
+    expect_policy = f @ w["pol_w"].T + w["pol_b"]
+    expect_value = np.tanh(f @ w["val_w"].T + w["val_b"])
+
+    out = loaded.inference(obs)
+    np.testing.assert_allclose(out["policy"], expect_policy[0],
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(out["value"], expect_value[0],
+                               rtol=1e-4, atol=1e-5)
+
+    # then full matches, both seats
+    agents = {0: Agent(loaded), 1: RandomAgent()}
+    results = [exec_match(env, agents) for _ in range(3)]
+    agents = {0: RandomAgent(), 1: Agent(loaded)}
+    results += [exec_match(env, agents) for _ in range(3)]
+    assert all(r is not None for r in results)
+    assert all(-1.0 <= r[0] <= 1.0 for r in results)
+
+
+def test_unsupported_op_errors_are_named(tmp_path):
+    """Graphs using ops outside the runner's coverage (e.g. a real
+    LSTM node) fail loudly with the op named, not with garbage."""
+    from handyrl_tpu.interop.onnx_export import _value_info
+    from handyrl_tpu.interop.onnx_proto import encode
+    from handyrl_tpu.interop.onnx_run import OnnxModel
+
+    graph = {
+        "name": "lstm_graph",
+        "node": [{"op_type": "LSTM", "input": ["input"],
+                  "output": ["policy"], "attribute": []}],
+        "initializer": [],
+        "input": [_value_info("input", (1, 4))],
+        "output": [_value_info("policy", (1, 4))],
+    }
+    blob = encode({"ir_version": 8, "graph": graph,
+                   "opset_import": [{"domain": "", "version": 13}]},
+                  "Model")
+    path = str(tmp_path / "lstm.onnx")
+    with open(path, "wb") as f:
+        f.write(blob)
+    om = OnnxModel(path)
+    with pytest.raises(NotImplementedError, match="LSTM"):
+        om.inference(np.zeros(4, np.float32))
