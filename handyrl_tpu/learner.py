@@ -483,7 +483,11 @@ class Trainer:
             if msg:
                 if mode == "on":
                     raise ValueError(msg)
-                print(msg + ": using the host batcher path")
+                # LOUD: in a pod launch log a one-line note is easy to
+                # miss, and the host batcher feed is ~13x slower
+                print("WARNING: " + msg + " — falling back to the "
+                      "host batcher path (measured ~13x slower feed); "
+                      "set device_replay: on to make this an error")
                 return None
             mesh = mh.local_replay_mesh(mesh)
         from .staging import DeviceReplay
