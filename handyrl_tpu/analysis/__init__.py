@@ -1,0 +1,32 @@
+"""Static analysis + runtime guards for JAX/TPU correctness.
+
+Two halves, one goal — keep the learner hot path device-bound and
+trace-stable as the codebase grows:
+
+  * :mod:`handyrl_tpu.analysis.jaxlint` — an AST-based analyzer (stdlib
+    ``ast`` only, no runtime jax import) that enforces the classic JAX
+    invariants repo-wide: no PRNG key reuse, no Python branching on
+    tracers inside jitted code, no host syncs in hot loops, no
+    use-after-donation, no retrace-forcing jit patterns, no leftover
+    debug calls.  CLI: ``python -m handyrl_tpu.analysis.jaxlint``.
+  * :mod:`handyrl_tpu.analysis.guards` — runtime context managers that
+    measure what the linter cannot prove: ``RetraceGuard`` (compile
+    counts of the update step) and ``HostTransferGuard``
+    (device->host transfer counts per epoch).
+
+Guard classes are re-exported lazily (PEP 562) so importing the
+analysis package — e.g. from the jaxlint CLI — never pulls in jax.
+"""
+
+_GUARD_EXPORTS = ("RetraceGuard", "RetraceError", "HostTransferGuard",
+                  "HostTransferError")
+
+__all__ = list(_GUARD_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _GUARD_EXPORTS:
+        from . import guards
+
+        return getattr(guards, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,935 @@
+"""AST machinery behind jaxlint: package model + taint analyses.
+
+jaxlint's rules need to answer three questions that plain per-line
+pattern matching cannot:
+
+  * which functions execute *inside* a jit trace?  (``jax.jit`` /
+    ``shard_map`` entry points, plus everything reachable from them
+    through direct calls, ``jax.tree.map``-style higher-order calls,
+    ``lax.scan`` bodies and ``jax.grad`` closures);
+  * which values are *tracers* there?  (entry parameters minus
+    ``static_argnums``, propagated through assignments — but NOT
+    through ``.shape`` / ``.dtype`` / ``len()`` / ``is None``, which
+    are static at trace time and therefore safe to branch on);
+  * which host-side values are *device arrays*?  (results of ``jnp.*``
+    producers and of calling jit-compiled callables, propagated
+    through containers, attributes and function-return summaries — so
+    ``float(metrics["loss"])`` in an epoch loop is recognized as a
+    device->host sync even though ``metrics`` crossed two functions).
+
+Everything here is stdlib ``ast`` only — the linter never imports jax,
+so it runs in CI and pre-commit in a few seconds for the whole
+package, with no backend initialization.
+
+The analyses are deliberately *monotone and approximate*: taint only
+ever grows, locals are flow-insensitive within a function, and
+unresolvable calls default to "tainted if any argument is tainted".
+That bias keeps the engine small and the false-negative rate low; the
+per-rule suppression syntax (see :mod:`.jaxlint`) is the escape hatch
+for the rare intentional violation.
+"""
+
+import ast
+from collections import deque
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+# Attribute reads that yield static (trace-time) metadata, never a
+# tracer/device value: branching on these is always safe.
+SAFE_ATTRS = frozenset({
+    "shape", "ndim", "dtype", "size", "aval", "weak_type", "sharding",
+    "itemsize", "nbytes", "is_fully_replicated", "is_deleted",
+})
+
+# Transform wrappers whose first argument becomes a jit entry point.
+JIT_WRAPPERS = frozenset({
+    "jax.jit", "jax.pmap", "pjit", "jax.experimental.pjit.pjit",
+    "shard_map", "jax.experimental.shard_map.shard_map",
+})
+
+# Calls whose result is definitely host data (break device taint).
+HOST_RESULT_FNS = frozenset({
+    "jax.device_get", "numpy.asarray", "numpy.array", "numpy.shape",
+    "float", "int", "bool", "len", "isinstance", "type", "str", "repr",
+    "hasattr", "callable",
+})
+
+# Call-name prefixes whose results live on device even with host args.
+DEVICE_PRODUCER_PREFIXES = (
+    "jax.numpy.", "jax.lax.", "jax.random.", "jax.nn.", "jax.scipy.",
+    "jax.image.", "jax.ops.",
+)
+DEVICE_PRODUCER_FNS = frozenset({
+    "jax.device_put", "jax.make_array_from_callback",
+    "jax.make_array_from_process_local_data",
+    "jax.make_array_from_single_device_arrays",
+})
+
+# Spelling normalization applied after import-alias expansion.
+_CANON = {
+    "jax.tree_util.tree_map": "jax.tree.map",
+    "jax.tree_map": "jax.tree.map",
+    "jax.tree_util.tree_leaves": "jax.tree.leaves",
+}
+
+
+def dotted_parts(node) -> Optional[List[str]]:
+    """``a.b.c`` attribute chain -> ``["a", "b", "c"]`` (None if the
+    chain bottoms out in anything but a plain Name)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    parts.reverse()
+    return parts
+
+
+@dataclass
+class JitMeta:
+    """Trace-relevant options of one ``jax.jit`` (or equivalent) call."""
+
+    donate: Tuple[int, ...] = ()
+    static_nums: Tuple[int, ...] = ()
+    static_names: Tuple[str, ...] = ()
+    constant_opts: bool = True  # False: options were not literals
+
+
+def _const_ints(node) -> Optional[Tuple[int, ...]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for el in node.elts:
+            if not (isinstance(el, ast.Constant)
+                    and isinstance(el.value, int)):
+                return None
+            vals.append(el.value)
+        return tuple(vals)
+    return None
+
+
+def _const_strs(node) -> Optional[Tuple[str, ...]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for el in node.elts:
+            if not (isinstance(el, ast.Constant)
+                    and isinstance(el.value, str)):
+                return None
+            vals.append(el.value)
+        return tuple(vals)
+    return None
+
+
+def jit_meta_from_call(call: ast.Call) -> JitMeta:
+    """Parse donate/static options off a ``jax.jit(...)`` call node."""
+    meta = JitMeta()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            vals = _const_ints(kw.value)
+            if vals is None:
+                meta.constant_opts = False
+            else:
+                meta.donate = vals
+        elif kw.arg == "static_argnums":
+            vals = _const_ints(kw.value)
+            if vals is None:
+                meta.constant_opts = False
+            else:
+                meta.static_nums = vals
+        elif kw.arg == "static_argnames":
+            vals = _const_strs(kw.value)
+            if vals is None:
+                meta.constant_opts = False
+            else:
+                meta.static_names = vals
+    return meta
+
+
+class FunctionInfo:
+    """One function/method/lambda in the scanned package."""
+
+    def __init__(self, qname, node, module, parent, cls_name):
+        self.qname = qname
+        self.node = node
+        self.module = module
+        self.parent = parent          # enclosing FunctionInfo or None
+        self.cls_name = cls_name      # enclosing class name or None
+        args = node.args
+        self.pos_params = [a.arg for a in args.posonlyargs + args.args]
+        self.all_params = list(self.pos_params)
+        if args.vararg:
+            self.all_params.append(args.vararg.arg)
+        self.all_params += [a.arg for a in args.kwonlyargs]
+        if args.kwarg:
+            self.all_params.append(args.kwarg.arg)
+        self.local_defs: Dict[str, "FunctionInfo"] = {}
+
+        # tracer-taint state (grown by the interprocedural worklist)
+        self.jit_reachable = False
+        self.tainted_params: Set[str] = set()
+        self.tracer_locals: Set[str] = set()
+
+        # device-taint state (grown by the package fixpoint)
+        self.device_params: Set[str] = set()
+        self.device_locals: Set[str] = set()
+        self.returns_device = False
+        self.returns_jit: Optional[JitMeta] = None
+        self.jit_locals: Dict[str, JitMeta] = {}
+
+    @property
+    def callable_params(self) -> List[str]:
+        """Positional params as seen by callers (``self``/``cls``
+        dropped for methods)."""
+        if self.cls_name and self.pos_params[:1] in (["self"], ["cls"]):
+            return self.pos_params[1:]
+        return self.pos_params
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"<fn {self.qname}>"
+
+
+class ModuleInfo:
+    """Parsed module + symbol tables."""
+
+    def __init__(self, name: str, path: str, source: str):
+        self.name = name
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.aliases: Dict[str, str] = {}         # name -> external dotted
+        self.from_imports: Dict[str, Tuple[str, str]] = {}  # name -> (mod, sym)
+        self.functions: List[FunctionInfo] = []
+        self.by_node: Dict[ast.AST, FunctionInfo] = {}
+        self.toplevel: Dict[str, FunctionInfo] = {}
+        self.classes: Dict[str, Dict[str, FunctionInfo]] = {}
+        # per-class attribute facts discovered by the device fixpoint
+        self.class_jit_attrs: Dict[str, Dict[str, JitMeta]] = {}
+        self.class_device_attrs: Dict[str, Set[str]] = {}
+        _Collector(self).visit(self.tree)
+
+
+class _Collector(ast.NodeVisitor):
+    """Builds the function/import tables of one module."""
+
+    def __init__(self, module: ModuleInfo):
+        self.m = module
+        self.fn_stack: List[FunctionInfo] = []
+        self.cls_stack: List[str] = []
+
+    # -- imports -----------------------------------------------------
+    def visit_Import(self, node):
+        for alias in node.names:
+            name = alias.asname or alias.name.split(".")[0]
+            target = alias.name if alias.asname else alias.name.split(".")[0]
+            self.m.aliases[name] = target
+
+    def visit_ImportFrom(self, node):
+        if node.level > 0:
+            base = self.m.name.split(".")
+            base = base[: len(base) - node.level]
+            target_mod = ".".join(base + ([node.module] if node.module
+                                          else []))
+        else:
+            target_mod = node.module or ""
+        for alias in node.names:
+            name = alias.asname or alias.name
+            self.m.from_imports[name] = (target_mod, alias.name)
+
+    # -- scopes ------------------------------------------------------
+    def visit_ClassDef(self, node):
+        self.cls_stack.append(node.name)
+        self.m.classes.setdefault(node.name, {})
+        self.generic_visit(node)
+        self.cls_stack.pop()
+
+    def _enter_function(self, node, name):
+        parent = self.fn_stack[-1] if self.fn_stack else None
+        cls = self.cls_stack[-1] if self.cls_stack else None
+        scope = ".".join(
+            ([cls] if cls else [])
+            + [f.qname.rsplit(":", 1)[1] for f in self.fn_stack[-1:]]
+        )
+        qname = f"{self.m.name}:{scope + '.' if scope else ''}{name}"
+        info = FunctionInfo(qname, node, self.m, parent, cls)
+        self.m.functions.append(info)
+        self.m.by_node[node] = info
+        if parent is not None:
+            parent.local_defs[name] = info
+        elif cls is not None:
+            self.m.classes[cls][name] = info
+        else:
+            self.m.toplevel[name] = info
+        self.fn_stack.append(info)
+        self.generic_visit(node)
+        self.fn_stack.pop()
+
+    def visit_FunctionDef(self, node):
+        self._enter_function(node, node.name)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._enter_function(node, node.name)
+
+    def visit_Lambda(self, node):
+        self._enter_function(node, f"<lambda:{node.lineno}>")
+
+
+class Package:
+    """All scanned modules + cross-module resolution."""
+
+    def __init__(self, modules: List[ModuleInfo]):
+        self.modules = {m.name: m for m in modules}
+
+    # -- name resolution --------------------------------------------
+    def lookup(self, module_name: str, symbol: str):
+        mod = self.modules.get(module_name)
+        if mod is None:
+            return None
+        fn = mod.toplevel.get(symbol)
+        if fn is not None:
+            return fn
+        # chase one re-export hop (``from .x import y`` in __init__)
+        imp = mod.from_imports.get(symbol)
+        if imp is not None:
+            target, orig = imp
+            target_mod = self.modules.get(target)
+            if target_mod is not None:
+                return target_mod.toplevel.get(orig)
+        return None
+
+    def resolve_name(self, module: ModuleInfo, scope: Optional[FunctionInfo],
+                     name: str):
+        """A bare name -> ("fn", FunctionInfo) | ("ext", dotted) | None."""
+        fn_scope = scope
+        while fn_scope is not None:
+            if name in fn_scope.local_defs:
+                return ("fn", fn_scope.local_defs[name])
+            fn_scope = fn_scope.parent
+        if name in module.toplevel:
+            return ("fn", module.toplevel[name])
+        if name in module.from_imports:
+            target_mod, orig = module.from_imports[name]
+            fn = self.lookup(target_mod, orig)
+            if fn is not None:
+                return ("fn", fn)
+            return ("ext", f"{target_mod}.{orig}" if target_mod else orig)
+        if name in module.aliases:
+            return ("ext", module.aliases[name])
+        return ("ext", name)  # builtins / globals: keep the raw name
+
+    def full_name(self, module: ModuleInfo, scope, node) -> Optional[str]:
+        """Dotted call-target name with import aliases expanded, e.g.
+        ``jnp.where`` -> ``jax.numpy.where``.  None for computed
+        targets (``f()()``, subscripts)."""
+        parts = dotted_parts(node)
+        if parts is None:
+            return None
+        head, rest = parts[0], parts[1:]
+        resolved = self.resolve_name(module, scope, head)
+        if resolved is not None and resolved[0] == "ext":
+            head = resolved[1]
+        name = ".".join([head] + rest)
+        return _CANON.get(name, name)
+
+    def resolve_callee(self, module: ModuleInfo, scope, func):
+        """Call target -> ("fn", FunctionInfo) | ("ext", dotted) | None.
+
+        Handles local defs (through enclosing scopes), module-level
+        defs, package-relative imports (``from .ops.update import
+        make_update_step``), module aliases (``from .parallel import
+        multihost as mh`` -> ``mh.sync_epoch_code``), and
+        ``self.method`` within a class.
+        """
+        if isinstance(func, ast.Name):
+            return self.resolve_name(module, scope, func.id)
+        parts = dotted_parts(func)
+        if parts is None:
+            return None
+        if parts[0] == "self" and len(parts) == 2:
+            cls = _enclosing_class(scope)
+            if cls is not None:
+                method = module.classes.get(cls, {}).get(parts[1])
+                if method is not None:
+                    return ("fn", method)
+            return ("ext", f"self.{parts[1]}")
+        # module alias: ``from .parallel import multihost as mh``
+        if len(parts) == 2 and parts[0] in module.from_imports:
+            target_mod, orig = module.from_imports[parts[0]]
+            sub = f"{target_mod}.{orig}" if target_mod else orig
+            fn = self.lookup(sub, parts[1])
+            if fn is not None:
+                return ("fn", fn)
+        name = self.full_name(module, scope, func)
+        return ("ext", name) if name else None
+
+    def all_functions(self):
+        for mod in self.modules.values():
+            for fn in mod.functions:
+                yield fn
+
+
+def _enclosing_class(scope: Optional[FunctionInfo]) -> Optional[str]:
+    while scope is not None:
+        if scope.cls_name is not None:
+            return scope.cls_name
+        scope = scope.parent
+    return None
+
+
+# ---------------------------------------------------------------------
+# taint evaluation
+# ---------------------------------------------------------------------
+
+_UNTAINT_CALLS = frozenset({
+    "len", "isinstance", "type", "hasattr", "callable", "id", "repr",
+    "print", "sorted" , "range", "enumerate", "zip", "min", "max",
+})
+# NOTE: float/int/bool are *not* here for tracer taint — calling them
+# on a tracer is itself a violation (host-sync rule); their result
+# taint is moot because the trace already failed.
+
+
+class _TaintWalk(ast.NodeVisitor):
+    """Shared statement walker: monotone name-taint over one function
+    body, with the value logic supplied by a subclass.
+
+    Runs the body to a fixpoint (loops make taint flow backward), then
+    a final pass that records the facts rules consume (calls made,
+    function-valued arguments, return taint).
+    """
+
+    MAX_PASSES = 4
+
+    def __init__(self, fn: FunctionInfo, package: Package):
+        self.fn = fn
+        self.pkg = package
+        self.module = fn.module
+        self.tainted: Set[str] = set()
+        self.calls: List[Tuple] = []      # (resolution, node, arg_taints, kw_taints)
+        self.fn_args: List[Tuple] = []    # (FunctionInfo, call node, any_other_arg_tainted)
+        self.return_tainted = False
+        self.collect = False
+
+    def run(self):
+        body = self.fn.node.body
+        if isinstance(self.fn.node, ast.Lambda):
+            body = [ast.Expr(self.fn.node.body)]
+        for _ in range(self.MAX_PASSES):
+            before = set(self.tainted)
+            for stmt in body:
+                self.handle_stmt(stmt)
+            if self.tainted == before:
+                break
+        self.collect = True
+        for stmt in body:
+            self.handle_stmt(stmt)
+        return self
+
+    # -- statements --------------------------------------------------
+    def handle_stmt(self, stmt):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested defs analyze as their own functions
+        if isinstance(stmt, ast.Assign):
+            t = self.taint(stmt.value)
+            for tgt in stmt.targets:
+                self.assign(tgt, stmt.value, t)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self.assign(stmt.target, stmt.value, self.taint(stmt.value))
+        elif isinstance(stmt, ast.AugAssign):
+            t = self.taint(stmt.value) or self.taint(stmt.target)
+            self.assign(stmt.target, stmt.value, t)
+        elif isinstance(stmt, ast.For):
+            self.assign_iteration(stmt.target, stmt.iter)
+            for s in stmt.body + stmt.orelse:
+                self.handle_stmt(s)
+        elif isinstance(stmt, ast.While):
+            self.taint(stmt.test)
+            for s in stmt.body + stmt.orelse:
+                self.handle_stmt(s)
+        elif isinstance(stmt, ast.If):
+            self.taint(stmt.test)
+            for s in stmt.body + stmt.orelse:
+                self.handle_stmt(s)
+        elif isinstance(stmt, ast.With):
+            for item in stmt.items:
+                t = self.taint(item.context_expr)
+                if item.optional_vars is not None:
+                    self.assign(item.optional_vars, item.context_expr, t)
+            for s in stmt.body:
+                self.handle_stmt(s)
+        elif isinstance(stmt, ast.Try):
+            for s in (stmt.body + stmt.orelse + stmt.finalbody
+                      + [h for hand in stmt.handlers for h in hand.body]):
+                self.handle_stmt(s)
+        elif isinstance(stmt, ast.Return):
+            if stmt.value is not None and self.taint(stmt.value):
+                self.return_tainted = True
+            if stmt.value is not None:
+                self.handle_return(stmt.value)
+        elif isinstance(stmt, ast.Expr):
+            self.taint(stmt.value)
+            self.handle_expr_stmt(stmt.value)
+        elif isinstance(stmt, (ast.Raise, ast.Assert)):
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self.taint(child)
+        # Pass/Break/Continue/Import/Global/Delete: nothing to do
+
+    def handle_return(self, value):
+        """Hook for subclasses (device mode records jit-value returns)."""
+
+    def handle_expr_stmt(self, value):
+        """Hook for subclasses (device mode tracks ``lst.append(x)``)."""
+
+    # -- assignment --------------------------------------------------
+    def assign(self, target, value, tainted: bool):
+        if isinstance(target, ast.Name):
+            if tainted:
+                self.tainted.add(target.id)
+            self.assign_name(target.id, value, tainted)
+        elif isinstance(target, ast.Starred):
+            self.assign(target.value, value, tainted)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            if isinstance(value, (ast.Tuple, ast.List)) \
+                    and len(value.elts) == len(target.elts):
+                for t, v in zip(target.elts, value.elts):
+                    self.assign(t, v, self.taint(v))
+            else:
+                for t in target.elts:
+                    self.assign(t, value, tainted)
+        elif isinstance(target, ast.Attribute):
+            self.assign_attr(target, value, tainted)
+        elif isinstance(target, ast.Subscript):
+            # writing a tainted value into a container taints it
+            if tainted and isinstance(target.value, ast.Name):
+                self.tainted.add(target.value.id)
+
+    def assign_name(self, name, value, tainted):
+        """Hook for subclasses (device mode tracks jit-value names)."""
+
+    def assign_attr(self, target, value, tainted):
+        """Hook for subclasses (device mode tracks ``self.x`` facts)."""
+
+    def assign_iteration(self, target, iter_expr):
+        """``for target in iter_expr`` — dict ``.items()`` keys stay
+        untainted (they are static strings in practice)."""
+        t = self.taint(iter_expr)
+        if (t and isinstance(iter_expr, ast.Call)
+                and isinstance(iter_expr.func, ast.Attribute)
+                and iter_expr.func.attr == "items"
+                and isinstance(target, (ast.Tuple, ast.List))
+                and len(target.elts) == 2):
+            self.assign(target.elts[0], iter_expr, False)
+            self.assign(target.elts[1], iter_expr, True)
+            return
+        if (isinstance(iter_expr, ast.Call)
+                and isinstance(iter_expr.func, ast.Attribute)
+                and iter_expr.func.attr == "keys"):
+            t = False
+        self.assign(target, iter_expr, t)
+
+    # -- expressions -------------------------------------------------
+    def taint(self, e) -> bool:
+        if e is None or isinstance(e, (ast.Constant, ast.JoinedStr)):
+            return False
+        if isinstance(e, ast.Name):
+            return e.id in self.tainted
+        if isinstance(e, ast.Attribute):
+            if e.attr in SAFE_ATTRS:
+                self.taint(e.value)
+                return False
+            return self.attr_taint(e)
+        if isinstance(e, ast.Subscript):
+            return self.taint(e.value) or self.taint(e.slice)
+        if isinstance(e, (ast.BinOp,)):
+            left, right = self.taint(e.left), self.taint(e.right)
+            return left or right
+        if isinstance(e, ast.UnaryOp):
+            return self.taint(e.operand)
+        if isinstance(e, ast.BoolOp):
+            return any([self.taint(v) for v in e.values])
+        if isinstance(e, ast.Compare):
+            subs = [self.taint(e.left)] + [self.taint(c)
+                                           for c in e.comparators]
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in e.ops):
+                return False  # ``x is None`` guards are static
+            if any(isinstance(op, (ast.In, ast.NotIn)) for op in e.ops):
+                return False  # dict/key membership idiom
+            return any(subs)
+        if isinstance(e, ast.Call):
+            return self.call_taint(e)
+        if isinstance(e, ast.IfExp):
+            self.taint(e.test)
+            body, orelse = self.taint(e.body), self.taint(e.orelse)
+            return body or orelse
+        if isinstance(e, (ast.Tuple, ast.List, ast.Set)):
+            return any([self.taint(el) for el in e.elts])
+        if isinstance(e, ast.Dict):
+            keys = [self.taint(k) for k in e.keys if k is not None]
+            vals = [self.taint(v) for v in e.values]
+            return any(keys) or any(vals)
+        if isinstance(e, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            self._comp_generators(e.generators)
+            return self.taint(e.elt)
+        if isinstance(e, ast.DictComp):
+            self._comp_generators(e.generators)
+            k, v = self.taint(e.key), self.taint(e.value)
+            return k or v
+        if isinstance(e, ast.Starred):
+            return self.taint(e.value)
+        if isinstance(e, (ast.Await, ast.YieldFrom)):
+            return self.taint(e.value)
+        if isinstance(e, ast.Yield):
+            return self.taint(e.value) if e.value else False
+        if isinstance(e, ast.NamedExpr):
+            t = self.taint(e.value)
+            self.assign(e.target, e.value, t)
+            return t
+        if isinstance(e, ast.Lambda):
+            return False  # a function value, not a data value
+        if isinstance(e, ast.Slice):
+            for part in (e.lower, e.upper, e.step):
+                self.taint(part)
+            return False
+        if isinstance(e, ast.FormattedValue):
+            self.taint(e.value)
+            return False
+        return False
+
+    def _comp_generators(self, generators):
+        for gen in generators:
+            self.assign_iteration(gen.target, gen.iter)
+            for cond in gen.ifs:
+                self.taint(cond)
+
+    def attr_taint(self, e: ast.Attribute) -> bool:
+        return self.taint(e.value)
+
+    # -- calls -------------------------------------------------------
+    def call_taint(self, call: ast.Call) -> bool:
+        arg_taints = [self.taint(a) for a in call.args]
+        kw_taints = {kw.arg: self.taint(kw.value) for kw in call.keywords}
+        name = self.pkg.full_name(self.module, self.fn, call.func)
+        resolution = self.pkg.resolve_callee(self.module, self.fn,
+                                             call.func)
+        if self.collect:
+            self.calls.append((resolution, call, arg_taints, kw_taints))
+            any_tainted = any(arg_taints) or any(kw_taints.values())
+            for arg in list(call.args) + [kw.value for kw in call.keywords]:
+                target = self._as_function_value(arg)
+                if target is not None:
+                    self.fn_args.append((target, call, any_tainted))
+        return self.result_taint(name, resolution, call, arg_taints,
+                                 kw_taints)
+
+    def _as_function_value(self, expr) -> Optional[FunctionInfo]:
+        """An argument that is itself a function (lambda or reference
+        to a local/module def) — the higher-order propagation targets."""
+        if isinstance(expr, ast.Lambda):
+            return self.module.by_node.get(expr)
+        if isinstance(expr, (ast.Name, ast.Attribute)):
+            res = self.pkg.resolve_callee(self.module, self.fn, expr)
+            if res is not None and res[0] == "fn":
+                return res[1]
+        return None
+
+    def result_taint(self, name, resolution, call, arg_taints, kw_taints):
+        raise NotImplementedError
+
+
+class TracerTaint(_TaintWalk):
+    """Taint = "is a tracer inside the jit trace"."""
+
+    def __init__(self, fn, package):
+        super().__init__(fn, package)
+        self.tainted = set(fn.tainted_params)
+
+    def result_taint(self, name, resolution, call, arg_taints, kw_taints):
+        if name is not None:
+            if name in _UNTAINT_CALLS:
+                return False
+            if (name == "getattr" and len(call.args) >= 2
+                    and isinstance(call.args[1], ast.Constant)
+                    and call.args[1].value in SAFE_ATTRS):
+                return False
+        func_tainted = (isinstance(call.func, ast.Attribute)
+                        and self.taint(call.func.value)
+                        and call.func.attr not in SAFE_ATTRS)
+        return (any(arg_taints) or any(kw_taints.values())
+                or func_tainted)
+
+
+class DeviceTaint(_TaintWalk):
+    """Taint = "is (or contains) a device array" on the host side.
+
+    Runs on every function; cross-function facts (return summaries,
+    ``self.X`` attribute facts, higher-order parameter injection) live
+    on the FunctionInfo/ModuleInfo objects and are grown by the
+    package-level fixpoint in :func:`compute_device_summaries`.
+    """
+
+    def __init__(self, fn, package):
+        super().__init__(fn, package)
+        self.tainted = set(fn.device_params)
+        self.jit_names: Dict[str, JitMeta] = dict(fn.jit_locals)
+        self.return_jit: Optional[JitMeta] = None
+
+    # -- jit-value tracking ------------------------------------------
+    def jit_value(self, e) -> Optional[JitMeta]:
+        """Is this expression a jit-compiled callable?  Follows the
+        wrapper idiom: any call with a jitted argument yields a jitted
+        callable (``guard.wrap(jitted)``, ``functools.partial``)."""
+        if isinstance(e, ast.Name):
+            return self.jit_names.get(e.id)
+        if isinstance(e, ast.Attribute):
+            parts = dotted_parts(e)
+            cls = _enclosing_class(self.fn)
+            if (parts is not None and len(parts) == 2
+                    and parts[0] == "self" and cls is not None):
+                return self.module.class_jit_attrs.get(cls, {}).get(
+                    parts[1])
+            return None
+        if isinstance(e, ast.Call):
+            name = self.pkg.full_name(self.module, self.fn, e.func)
+            if name in JIT_WRAPPERS:
+                return jit_meta_from_call(e)
+            res = self.pkg.resolve_callee(self.module, self.fn, e.func)
+            if res is not None and res[0] == "fn" \
+                    and res[1].returns_jit is not None:
+                return res[1].returns_jit
+            for arg in list(e.args) + [kw.value for kw in e.keywords]:
+                meta = self.jit_value(arg)
+                if meta is not None:
+                    return meta
+        return None
+
+    def assign_name(self, name, value, tainted):
+        # strong update: rebinding a name to a host value clears its
+        # device taint, so the ``metrics = jax.device_get(metrics)``
+        # laundering idiom works.  (Tracer taint stays monotone — a
+        # tracer cannot be un-traced.)
+        if not tainted:
+            self.tainted.discard(name)
+        meta = self.jit_value(value)
+        if meta is not None:
+            self.jit_names[name] = meta
+
+    def assign_attr(self, target, value, tainted):
+        parts = dotted_parts(target)
+        cls = _enclosing_class(self.fn)
+        if parts is None or len(parts) != 2 or parts[0] != "self" \
+                or cls is None:
+            return
+        meta = self.jit_value(value)
+        if meta is not None:
+            self.module.class_jit_attrs.setdefault(cls, {})[parts[1]] = meta
+        if tainted:
+            self.module.class_device_attrs.setdefault(cls, set()).add(
+                parts[1])
+
+    def attr_taint(self, e: ast.Attribute) -> bool:
+        parts = dotted_parts(e)
+        cls = _enclosing_class(self.fn)
+        if (parts is not None and len(parts) == 2 and parts[0] == "self"
+                and cls is not None
+                and parts[1] in self.module.class_device_attrs.get(
+                    cls, ())):
+            return True
+        return super().attr_taint(e)
+
+    def handle_return(self, value):
+        meta = self.jit_value(value)
+        if meta is not None:
+            self.return_jit = meta
+
+    def handle_expr_stmt(self, value):
+        # ``lst.append(device_value)`` taints the container
+        if (isinstance(value, ast.Call)
+                and isinstance(value.func, ast.Attribute)
+                and value.func.attr in ("append", "extend", "insert",
+                                        "add", "appendleft")
+                and isinstance(value.func.value, ast.Name)
+                and any(self.taint(a) for a in value.args)):
+            self.tainted.add(value.func.value.id)
+
+    def result_taint(self, name, resolution, call, arg_taints, kw_taints):
+        if name is not None:
+            if name in HOST_RESULT_FNS or name.startswith("numpy."):
+                return False
+            if name in DEVICE_PRODUCER_FNS or name.startswith(
+                    DEVICE_PRODUCER_PREFIXES):
+                return True
+        if self.jit_value(call.func) is not None:
+            return True  # calling a jitted callable -> device result
+        if resolution is not None and resolution[0] == "fn" \
+                and resolution[1].returns_device:
+            return True
+        func_tainted = (isinstance(call.func, ast.Attribute)
+                        and call.func.attr not in SAFE_ATTRS
+                        and self.taint(call.func.value))
+        return (any(arg_taints) or any(kw_taints.values())
+                or func_tainted)
+
+
+# ---------------------------------------------------------------------
+# package-level drivers
+# ---------------------------------------------------------------------
+
+def find_jit_entries(package: Package):
+    """Yield ``(FunctionInfo, static_param_names)`` for every function
+    that is a direct jit/shard_map/pmap entry point (by decorator or by
+    being passed to the wrapper), package-wide."""
+    for mod in package.modules.values():
+        # decorators
+        for fn in mod.functions:
+            if isinstance(fn.node, ast.Lambda):
+                continue
+            for dec in fn.node.decorator_list:
+                meta = _decorator_jit_meta(package, mod, fn, dec)
+                if meta is not None:
+                    yield fn, _static_names(fn, meta,
+                                            skip_self=False), meta
+        # call sites: jax.jit(f, ...)
+        for scope, call in _walk_calls(mod):
+            name = package.full_name(mod, scope, call.func)
+            if name not in JIT_WRAPPERS or not call.args:
+                continue
+            res = package.resolve_callee(mod, scope, call.args[0])
+            if res is None or res[0] != "fn":
+                target = call.args[0]
+                if isinstance(target, ast.Lambda):
+                    fn = mod.by_node.get(target)
+                    if fn is not None:
+                        meta = jit_meta_from_call(call)
+                        yield fn, _static_names(fn, meta,
+                                                skip_self=False), meta
+                continue
+            fn = res[1]
+            meta = jit_meta_from_call(call)
+            skip_self = (isinstance(call.args[0], ast.Attribute)
+                         and dotted_parts(call.args[0]) is not None
+                         and dotted_parts(call.args[0])[0] == "self")
+            yield fn, _static_names(fn, meta, skip_self=skip_self), meta
+
+
+def _decorator_jit_meta(package, mod, fn, dec):
+    name = package.full_name(mod, fn.parent, dec)
+    if name in JIT_WRAPPERS:
+        return JitMeta()
+    if isinstance(dec, ast.Call):
+        dec_name = package.full_name(mod, fn.parent, dec.func)
+        if dec_name in JIT_WRAPPERS:
+            return jit_meta_from_call(dec)
+        if dec_name == "functools.partial" and dec.args:
+            inner = package.full_name(mod, fn.parent, dec.args[0])
+            if inner in JIT_WRAPPERS:
+                return jit_meta_from_call(dec)
+    return None
+
+
+def _static_names(fn: FunctionInfo, meta: JitMeta, skip_self: bool):
+    params = fn.pos_params[1:] if (
+        skip_self and fn.pos_params[:1] in (["self"], ["cls"])
+    ) else fn.pos_params
+    static = set(meta.static_names)
+    for idx in meta.static_nums:
+        if 0 <= idx < len(params):
+            static.add(params[idx])
+    return static
+
+
+def _walk_calls(mod: ModuleInfo):
+    """Every Call node with its enclosing FunctionInfo (or None)."""
+    out = []
+
+    def walk(node, scope):
+        for child in ast.iter_child_nodes(node):
+            child_scope = mod.by_node.get(child, scope)
+            if isinstance(child, ast.Call):
+                out.append((scope, child))
+            walk(child, child_scope)
+
+    walk(mod.tree, None)
+    return out
+
+
+def compute_tracer_taint(package: Package):
+    """Interprocedural worklist: mark jit-reachable functions and the
+    tracer taint of their parameters/locals."""
+    work = deque()
+
+    def seed(fn, tainted_params):
+        new = tainted_params - fn.tainted_params
+        if new or not fn.jit_reachable:
+            fn.jit_reachable = True
+            fn.tainted_params |= tainted_params
+            work.append(fn)
+
+    for fn, static, _meta in find_jit_entries(package):
+        params = set(fn.all_params) - static - {"self", "cls"}
+        seed(fn, params)
+
+    seen_guard = 0
+    while work and seen_guard < 10000:
+        seen_guard += 1
+        fn = work.popleft()
+        tt = TracerTaint(fn, package).run()
+        fn.tracer_locals = set(tt.tainted)
+        for resolution, call, arg_taints, kw_taints in tt.calls:
+            if resolution is None or resolution[0] != "fn":
+                continue
+            callee = resolution[1]
+            params = callee.callable_params
+            tainted = set()
+            for idx, t in enumerate(arg_taints):
+                if not t:
+                    continue
+                if isinstance(call.args[idx], ast.Starred):
+                    # a tainted *splat can land anywhere from here on
+                    tainted.update(params[idx:])
+                elif idx < len(params):
+                    tainted.add(params[idx])
+            for kw, t in kw_taints.items():
+                if t and kw in callee.all_params:
+                    tainted.add(kw)
+            if tainted:
+                seed(callee, tainted)
+        for target, _call, _any_tainted in tt.fn_args:
+            # a function value passed around inside traced code will be
+            # called with tracers (tree.map / scan / grad / cond ...)
+            seed(target,
+                 set(target.all_params) - {"self", "cls"})
+
+
+def compute_device_summaries(package: Package, max_passes: int = 6):
+    """Package fixpoint for the host-side device-value facts."""
+    for _ in range(max_passes):
+        changed = False
+        for fn in package.all_functions():
+            dt = DeviceTaint(fn, package).run()
+            if dt.return_tainted and not fn.returns_device:
+                fn.returns_device = True
+                changed = True
+            if dt.return_jit is not None and fn.returns_jit is None:
+                fn.returns_jit = dt.return_jit
+                changed = True
+            if dt.jit_names != fn.jit_locals:
+                fn.jit_locals = dict(dt.jit_names)
+                changed = True
+            if dt.tainted != fn.device_locals:
+                fn.device_locals = set(dt.tainted)
+                changed = True
+            # higher-order injection: lambdas mapped over device trees
+            for target, _call, any_tainted in dt.fn_args:
+                if any_tainted:
+                    params = set(target.all_params) - {"self", "cls"}
+                    if params - target.device_params:
+                        target.device_params |= params
+                        changed = True
+        if not changed:
+            break

@@ -1,0 +1,259 @@
+"""Runtime guards: retrace and host-transfer accounting.
+
+The static rules in :mod:`.rules` prove what they can from source; the
+two guards here measure what only a running program knows:
+
+  * :class:`RetraceGuard` wraps jitted callables and counts retraces —
+    the learner's update step must compile exactly once per run per
+    mesh shape, and a shape-churn regression (uneven batches, a dtype
+    flip) shows up as ``compiles > 1`` long before it shows up as a
+    100x slowdown on a TPU profile.  Counting is host-side abstract
+    signatures ((treedef, shape, dtype) per call — the part of the jit
+    cache key shape churn perturbs), so it works for any callable and
+    ignores the committed-ness variants that donated-buffer loops
+    create in the real jit cache without recompiling.
+  * :class:`HostTransferGuard` counts device->host transfers by
+    interposing on the Python-level sync entry points
+    (``jax.device_get``, ``np.asarray``, ``np.array``) while armed.
+    C-level syncs (``.item()``, ``float()`` on an array) cannot be
+    intercepted from Python — the static ``host-sync`` rule covers
+    those paths instead.
+
+Both are near-zero-cost (an isinstance check / an integer bump per
+event) and run armed in production: the learner feeds their per-epoch
+deltas into the metrics jsonl, so a regression is visible on the same
+plots as the loss curves.
+"""
+
+import threading
+
+import jax
+import numpy as np
+
+
+class RetraceError(RuntimeError):
+    """A guarded jit compiled more often than its budget allows."""
+
+
+class HostTransferError(RuntimeError):
+    """More device->host transfers than the armed budget allows."""
+
+
+class _GuardedJit:
+    """Callable proxy that counts retraces of one jitted fn.
+
+    Counts distinct abstract call signatures — (treedef, shape, dtype)
+    per leaf — which is exactly the part of the jit cache key that
+    shape churn perturbs.  The jit's own ``_cache_size()`` is NOT used:
+    it also keys on committed-ness/sharding, so a donated-buffer loop
+    (whose second call feeds back the first call's committed outputs)
+    legitimately grows that cache without any XLA recompile, and the
+    guard must not report it as one.
+    """
+
+    # every call is fingerprinted for the first WARM_CALLS, then one
+    # in SAMPLE_EVERY: the flatten-and-shape walk over params +
+    # optimizer state + batch is ~tens of microseconds, which is real
+    # money in a hot loop whose design goal is "the host passes three
+    # scalars per step".  Persistent shape churn is still caught
+    # within SAMPLE_EVERY steps; a single-call transient between
+    # samples can slip through (documented trade).
+    WARM_CALLS = 64
+    SAMPLE_EVERY = 8
+
+    def __init__(self, guard, fn):
+        self._guard = guard
+        self._fn = fn
+        self._signatures = set()
+        self._calls = 0
+
+    def _signature(self, args, kwargs):
+        leaves, treedef = jax.tree.flatten((args, kwargs))
+        return treedef, tuple(
+            (np.shape(leaf), getattr(leaf, "dtype", type(leaf)))
+            for leaf in leaves
+        )
+
+    def __call__(self, *args, **kwargs):
+        self._calls += 1
+        if (self._calls <= self.WARM_CALLS
+                or self._calls % self.SAMPLE_EVERY == 0):
+            # signature BEFORE the call: donated args are dead after
+            self._signatures.add(self._signature(args, kwargs))
+        out = self._fn(*args, **kwargs)
+        self._guard._after_call()
+        return out
+
+    @property
+    def compiles(self) -> int:
+        return len(self._signatures)
+
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
+
+
+class RetraceGuard:
+    """Compile-count accounting over one or more jitted callables.
+
+    ::
+
+        guard = RetraceGuard(max_compiles=1, name="update_step")
+        step = guard.wrap(make_update_step(...))
+        ...
+        guard.compiles        # total compilations so far
+        guard.check()         # raises RetraceError over budget
+
+    ``max_compiles=0`` disables the assertion (counting only).  The
+    check also runs after every wrapped call, so a retrace surfaces at
+    (or within a few steps of — see the sampling note on _GuardedJit)
+    the step that caused it, not at the end of the run.
+
+    ``allowance`` widens the budget for compiles the caller knows are
+    legitimate — the learner sets it to the replay ring's growth
+    count, so a designed T_max re-layout never trips the assertion.
+    """
+
+    def __init__(self, max_compiles: int = 0, name: str = "jit"):
+        self.max_compiles = int(max_compiles or 0)
+        # extra budget for compiles the caller KNOWS are legitimate
+        # (e.g. a replay-ring growth re-lays its buffers and the fused
+        # step must recompile once): the effective budget is
+        # ``max_compiles + allowance``
+        self.allowance = 0
+        self.name = name
+        self.calls = 0
+        self._wrapped = []
+
+    def wrap(self, fn):
+        """Wrap a jitted callable; returns the counting proxy."""
+        proxy = _GuardedJit(self, fn)
+        self._wrapped.append(proxy)
+        return proxy
+
+    @property
+    def compiles(self) -> int:
+        return sum(proxy.compiles for proxy in self._wrapped)
+
+    def _after_call(self):
+        self.calls += 1
+        self.check()
+
+    def check(self):
+        budget = self.max_compiles + self.allowance
+        if self.max_compiles and self.compiles > budget:
+            raise RetraceError(
+                f"{self.name} compiled {self.compiles} times "
+                f"(budget {budget}) over {self.calls} calls "
+                f"— input shapes/dtypes are churning; pad batches to "
+                f"fixed shapes or mark the varying argument static")
+
+
+class HostTransferGuard:
+    """Context manager counting device->host transfers while armed.
+
+    ::
+
+        with HostTransferGuard() as guard:
+            run_epoch()
+        print(guard.transfers)
+
+    Counts one transfer per ``jax.device_get`` call that touches a jax
+    array and one per ``np.asarray``/``np.array`` call on a jax array.
+    A long-lived guard can stay armed across epochs and report deltas
+    via :meth:`snapshot`.  Not reentrant (it patches module-level
+    entry points); arm one per process.
+    """
+
+    def __init__(self, max_transfers: int = 0):
+        self.max_transfers = int(max_transfers or 0)
+        self.transfers = 0
+        self._last_snapshot = 0
+        self._lock = threading.Lock()
+        self._saved = None
+
+    # -- counting ----------------------------------------------------
+    @staticmethod
+    def _contains_jax_array(value, budget: int = 64, depth: int = 3):
+        """Bounded containment probe: visits at most ``budget`` nodes
+        ``depth`` levels deep.  The guard is armed process-wide, so
+        this must NOT walk arbitrary host data — ``np.array(big_list)``
+        with a million floats costs a handful of isinstance checks
+        here, not a full tree flatten.  Deeply-buried device arrays
+        past the bound go uncounted (documented heuristic)."""
+        if isinstance(value, jax.Array):
+            return True
+        if depth == 0 or budget <= 0:
+            return False
+        if isinstance(value, dict):
+            items = value.values()
+        elif isinstance(value, (list, tuple)):
+            items = value
+        else:
+            return False
+        for i, item in enumerate(items):
+            if i >= budget:
+                return False
+            if HostTransferGuard._contains_jax_array(
+                    item, budget // 4, depth - 1):
+                return True
+        return False
+
+    def _note(self, value) -> None:
+        if isinstance(value, np.ndarray):
+            return  # fast path: host arrays dominate np.asarray traffic
+        if not self._contains_jax_array(value):
+            return
+        with self._lock:
+            self.transfers += 1
+            if self.max_transfers and self.transfers > self.max_transfers:
+                raise HostTransferError(
+                    f"host-transfer budget exceeded: {self.transfers} "
+                    f"device->host transfers (budget "
+                    f"{self.max_transfers})")
+
+    def snapshot(self) -> int:
+        """Transfers since the previous snapshot (per-epoch delta)."""
+        with self._lock:
+            delta = self.transfers - self._last_snapshot
+            self._last_snapshot = self.transfers
+            return delta
+
+    # -- arming ------------------------------------------------------
+    def __enter__(self):
+        if self._saved is not None:
+            raise RuntimeError("HostTransferGuard is not reentrant")
+        saved = {
+            "device_get": jax.device_get,
+            "asarray": np.asarray,
+            "array": np.array,
+        }
+
+        # fully generic signatures: the originals accept their first
+        # argument by keyword too (np.array(object=...), np.asarray(a=...),
+        # jax.device_get(x=...)), and a wrapper that renames it would
+        # crash any caller using the documented keyword form
+        def device_get(*args, **kwargs):
+            self._note(args[0] if args else kwargs.get("x"))
+            return saved["device_get"](*args, **kwargs)
+
+        def asarray(*args, **kwargs):
+            self._note(args[0] if args else kwargs.get("a"))
+            return saved["asarray"](*args, **kwargs)
+
+        def array(*args, **kwargs):
+            self._note(args[0] if args else kwargs.get("object"))
+            return saved["array"](*args, **kwargs)
+
+        jax.device_get = device_get
+        np.asarray = asarray
+        np.array = array
+        self._saved = saved
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        saved, self._saved = self._saved, None
+        if saved is not None:
+            jax.device_get = saved["device_get"]
+            np.asarray = saved["asarray"]
+            np.array = saved["array"]
+        return False

@@ -1,0 +1,314 @@
+"""jaxlint — AST-based JAX/TPU correctness analyzer (CLI + driver).
+
+Runs the rule set in :mod:`.rules` over a package directory (or single
+files), with per-line suppression comments and text/JSON output.
+Stdlib only; jax is never imported.
+
+Usage::
+
+    python -m handyrl_tpu.analysis.jaxlint handyrl_tpu/
+    python -m handyrl_tpu.analysis.jaxlint --json handyrl_tpu/
+    python -m handyrl_tpu.analysis.jaxlint --list-rules
+    handyrl-jaxlint handyrl_tpu/            # console-script entry
+
+Exit status: 0 when clean, 1 when any finding survives suppression,
+2 on usage/IO errors.
+
+Suppression syntax (the reason after ``--`` is REQUIRED — a
+suppression that doesn't say why is itself reported)::
+
+    x = foo()  # jaxlint: disable=host-sync -- once per epoch, by design
+    # jaxlint: disable=tracer-branch,prng-reuse -- trace-time constant
+    # jaxlint: skip-file -- generated code
+
+A ``disable`` comment applies to its own line; a comment-only line
+also covers the next line (so long statements can carry the
+suppression above their first line).  ``disable=all`` silences every
+rule.  ``skip-file`` (first 10 lines) skips the whole file.
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+from .astutil import (
+    ModuleInfo,
+    Package,
+    compute_device_summaries,
+    compute_tracer_taint,
+)
+from .rules import RULES, Finding
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*jaxlint:\s*(disable|skip-file)"
+    r"(?:\s*=\s*([\w\-]+(?:\s*,\s*[\w\-]+)*))?"
+    r"(?:\s+--\s+(\S.*))?")
+
+
+def _iter_comments(source: str) -> List[Tuple[int, str]]:
+    """``(lineno, comment_text)`` for every real comment token.
+
+    Falls back to whole-line scanning only if tokenization fails (the
+    file already parsed as AST before we get here, so that is rare)."""
+    import io
+    import tokenize
+
+    out: List[Tuple[int, str]] = []
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                out.append((tok.start[0], tok.string))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return [(lineno, line)
+                for lineno, line in enumerate(source.splitlines(), 1)
+                if "#" in line]
+    return out
+
+
+class Suppressions:
+    """Per-file suppression map parsed from REAL comment tokens — a
+    docstring or string literal that merely documents the syntax (this
+    module's own docstring, say) must neither suppress anything nor
+    count as a bare suppression."""
+
+    def __init__(self, source: str, path: str):
+        self.path = path
+        self.skip_file = False
+        self.by_line: Dict[int, Tuple[set, bool, int]] = {}
+        bare: List[Tuple[int, str]] = []
+        lines = source.splitlines()
+        for lineno, comment in _iter_comments(source):
+            match = _SUPPRESS_RE.search(comment)
+            if match is None:
+                continue
+            line = lines[lineno - 1] if lineno <= len(lines) else comment
+            verb, rules_str, reason = match.groups()
+            if verb == "skip-file":
+                if lineno <= 10:
+                    self.skip_file = True
+                if not reason:
+                    bare.append((lineno, "skip-file"))
+                continue
+            rules = {r.strip() for r in (rules_str or "all").split(",")
+                     if r.strip()}
+            comment_only = line.strip().startswith("#")
+            self.by_line[lineno] = (rules, comment_only, lineno)
+            if not reason:
+                bare.append((lineno, "disable=" + ",".join(sorted(rules))))
+        self.bare = bare
+
+    def covers(self, rule_id: str, lineno: int) -> bool:
+        for probe in (lineno, lineno - 1):
+            entry = self.by_line.get(probe)
+            if entry is None:
+                continue
+            rules, comment_only, _ = entry
+            if probe == lineno - 1 and not comment_only:
+                continue  # only standalone comments cover the next line
+            if "all" in rules or rule_id in rules:
+                return True
+        return False
+
+    def bare_findings(self) -> List[Finding]:
+        return [
+            Finding("bare-suppression", self.path, lineno, 0,
+                    f"suppression '{what}' has no reason — append "
+                    f"' -- <why this is safe>'")
+            for lineno, what in self.bare
+        ]
+
+
+def _iter_py_files(paths: List[str]):
+    for path in paths:
+        if os.path.isfile(path):
+            yield path
+        elif os.path.isdir(path):
+            for root, dirs, files in os.walk(path):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d not in ("__pycache__", ".git"))
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        yield os.path.join(root, name)
+        else:
+            raise FileNotFoundError(path)
+
+
+def _module_name(path: str, roots: List[str]) -> str:
+    """Dotted module name so package-relative imports resolve when a
+    package directory is scanned (``handyrl_tpu/ops/update.py`` ->
+    ``handyrl_tpu.ops.update``)."""
+    norm = os.path.normpath(path)
+    for root in roots:
+        parent = os.path.dirname(os.path.normpath(root))
+        if norm.startswith(os.path.normpath(root) + os.sep) \
+                or norm == os.path.normpath(root):
+            rel = os.path.relpath(norm, parent)
+            break
+    else:
+        rel = os.path.basename(norm)
+    rel = rel[:-3] if rel.endswith(".py") else rel
+    parts = rel.split(os.sep)
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def load_package(paths: List[str]):
+    """Parse every .py under ``paths`` into a Package + suppressions.
+
+    Returns ``(package, suppressions_by_path, errors)`` where errors
+    are (path, message) for unparseable files.
+    """
+    roots = [p for p in paths if os.path.isdir(p)]
+    modules, suppressions, errors = [], {}, []
+    for path in _iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            module = ModuleInfo(_module_name(path, roots), path, source)
+        except (SyntaxError, UnicodeDecodeError) as exc:
+            errors.append((path, str(exc)))
+            continue
+        modules.append(module)
+        suppressions[path] = Suppressions(source, path)
+    return Package(modules), suppressions, errors
+
+
+def lint_paths(paths: List[str],
+               select: Optional[List[str]] = None) -> List[Finding]:
+    """Run the (selected) rules over ``paths``; returns surviving
+    findings sorted by location."""
+    package, suppressions, errors = load_package(paths)
+    findings = [
+        Finding("parse-error", path, 1, 0, f"cannot parse: {msg}")
+        for path, msg in errors
+    ]
+    compute_tracer_taint(package)
+    compute_device_summaries(package)
+    active = [RULES[r] for r in (select or sorted(RULES))]
+    for mod in package.modules.values():
+        supp = suppressions[mod.path]
+        if supp.skip_file:
+            # a reason-less skip-file must not be a silent, zero-cost
+            # bypass of the whole gate: the bare suppression itself
+            # still surfaces (and fails CI) even though rules skip
+            findings.extend(supp.bare_findings())
+            continue
+        for rule in active:
+            for finding in rule.check(package, mod):
+                if not supp.covers(finding.rule, finding.line):
+                    findings.append(finding)
+        findings.extend(supp.bare_findings())
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def lint_source(source: str, name: str = "<string>",
+                select: Optional[List[str]] = None) -> List[Finding]:
+    """Lint one in-memory module (test/fixture helper)."""
+    module = ModuleInfo(name, name, source)
+    package = Package([module])
+    compute_tracer_taint(package)
+    compute_device_summaries(package)
+    supp = Suppressions(source, name)
+    findings: List[Finding] = []
+    if supp.skip_file:
+        findings.extend(supp.bare_findings())
+    else:
+        for rule_id in (select or sorted(RULES)):
+            for finding in RULES[rule_id].check(package, module):
+                if not supp.covers(finding.rule, finding.line):
+                    findings.append(finding)
+        findings.extend(supp.bare_findings())
+    findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return findings
+
+
+def _print_text(findings: List[Finding], file=None):
+    file = file or sys.stdout
+    for f in findings:
+        print(f"{f.location}: [{f.rule}] {f.message}", file=file)
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    if findings:
+        by_rule = ", ".join(f"{k}: {v}" for k, v in sorted(counts.items()))
+        print(f"\n{len(findings)} finding(s) ({by_rule})", file=file)
+    else:
+        print("jaxlint: clean", file=file)
+
+
+def _print_json(findings: List[Finding], file=None):
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    json.dump({
+        "findings": [
+            {"rule": f.rule, "path": f.path, "line": f.line,
+             "col": f.col + 1, "message": f.message}
+            for f in findings
+        ],
+        "counts": counts,
+        "total": len(findings),
+    }, file or sys.stdout, indent=2)
+    print(file=file or sys.stdout)
+
+
+def _print_rules():
+    for rule_id in sorted(RULES):
+        rule = RULES[rule_id]
+        print(f"{rule_id}: {rule.summary}")
+        doc = " ".join((rule.doc or "").split())
+        if doc:
+            print(f"    {doc}")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="jaxlint",
+        description="AST-based JAX/TPU correctness analyzer")
+    parser.add_argument("paths", nargs="*", default=None,
+                        help="files or package directories "
+                             "(default: handyrl_tpu)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable JSON output")
+    parser.add_argument("--select", default=None,
+                        help="comma-separated rule ids to run "
+                             "(default: all)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule registry and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        _print_rules()
+        return 0
+
+    select = None
+    if args.select:
+        select = [r.strip() for r in args.select.split(",") if r.strip()]
+        unknown = [r for r in select if r not in RULES]
+        if unknown:
+            print(f"jaxlint: unknown rule(s): {', '.join(unknown)}",
+                  file=sys.stderr)
+            return 2
+
+    paths = args.paths or ["handyrl_tpu"]
+    try:
+        findings = lint_paths(paths, select=select)
+    except FileNotFoundError as exc:
+        print(f"jaxlint: no such path: {exc}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        _print_json(findings)
+    else:
+        _print_text(findings)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

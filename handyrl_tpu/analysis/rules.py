@@ -1,0 +1,688 @@
+"""jaxlint's rule registry: the six JAX/TPU correctness rules.
+
+Each rule is a function ``(Package, ModuleInfo) -> Iterable[Finding]``
+registered under a stable kebab-case id (the id is what suppression
+comments name).  Rules consume the package model + taint facts built
+by :mod:`.astutil`; none of them import jax.
+
+The rules, and the TPU failure mode each one prevents:
+
+  ``prng-reuse``      same PRNG key consumed twice -> correlated
+                      "random" streams (silently wrong math).
+  ``tracer-branch``   Python ``if``/``while`` on a tracer inside
+                      jit-traced code -> trace-time concretization
+                      error, or one silent recompile per branch value.
+  ``host-sync``       ``.item()`` / ``float()`` / ``np.asarray()`` /
+                      ``jax.device_get`` on device values inside a loop
+                      -> the learner blocks on a device round trip
+                      every iteration (the #1 TPU throughput killer).
+  ``donated-reuse``   reading a buffer after passing it to a
+                      ``donate_argnums`` jit -> garbage data or a
+                      runtime "buffer deleted" error.
+  ``retrace-risk``    jit-in-a-loop / inline ``jax.jit(f)(x)`` /
+                      non-literal static options / non-hashable
+                      static arguments -> compile on every call.
+  ``debug-leftover``  ``jax.debug.print`` / ``breakpoint`` left in
+                      production code -> host callbacks serialized
+                      into the compiled program.
+"""
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .astutil import (
+    JIT_WRAPPERS,
+    DeviceTaint,
+    FunctionInfo,
+    ModuleInfo,
+    Package,
+    TracerTaint,
+    dotted_parts,
+    jit_meta_from_call,
+)
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    @property
+    def location(self) -> str:
+        return f"{self.path}:{self.line}:{self.col + 1}"
+
+
+@dataclass
+class Rule:
+    rule_id: str
+    summary: str
+    doc: str
+    check: "object"
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def rule(rule_id: str, summary: str):
+    def deco(fn):
+        RULES[rule_id] = Rule(rule_id, summary, fn.__doc__ or "", fn)
+        return fn
+    return deco
+
+
+# ---------------------------------------------------------------------
+# shared walking helpers
+# ---------------------------------------------------------------------
+
+_FN_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+_COMP_NODES = (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)
+
+
+def walk_with_context(mod: ModuleInfo) -> Iterator[Tuple[ast.AST,
+                                                         Optional[FunctionInfo],
+                                                         int]]:
+    """Yield every node with its enclosing function and loop depth.
+
+    Depths respect evaluation semantics: a ``for``'s iterable (and a
+    comprehension's FIRST iterable) evaluates once, outside the loop it
+    opens; a ``while`` test re-evaluates every iteration; comprehension
+    element/filter expressions run once per item.  Nested function
+    bodies restart the depth (they execute at their call site).
+    """
+    out = []
+
+    def child_of(node, scope, depth):
+        child_scope = mod.by_node.get(node, scope)
+        if isinstance(node, _FN_NODES):
+            depth = 0
+        out.append((node, child_scope, depth))
+        descend(node, child_scope, depth)
+
+    def descend(node, scope, depth):
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            child_of(node.iter, scope, depth)        # evaluates once
+            child_of(node.target, scope, depth + 1)
+            for sub in node.body + node.orelse:
+                child_of(sub, scope, depth + 1)
+            return
+        if isinstance(node, ast.While):
+            child_of(node.test, scope, depth + 1)    # per iteration
+            for sub in node.body + node.orelse:
+                child_of(sub, scope, depth + 1)
+            return
+        if isinstance(node, _COMP_NODES):
+            first = node.generators[0]
+            child_of(first.iter, scope, depth)       # evaluates once
+            child_of(first.target, scope, depth + 1)
+            for cond in first.ifs:
+                child_of(cond, scope, depth + 1)
+            for gen in node.generators[1:]:
+                for sub in ast.iter_child_nodes(gen):
+                    child_of(sub, scope, depth + 1)
+            for field in ("elt", "key", "value"):
+                sub = getattr(node, field, None)
+                if sub is not None:
+                    child_of(sub, scope, depth + 1)
+            return
+        for sub in ast.iter_child_nodes(node):
+            child_of(sub, scope, depth)
+
+    descend(mod.tree, None, 0)
+    return iter(out)
+
+
+def own_statements(fn: FunctionInfo) -> List[ast.stmt]:
+    body = fn.node.body
+    if isinstance(fn.node, ast.Lambda):
+        return [ast.Expr(fn.node.body)]
+    return body
+
+
+def own_nodes(fn: FunctionInfo) -> Iterator[ast.AST]:
+    """All nodes of ``fn``'s body, excluding nested function bodies."""
+
+    def walk(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _FN_NODES):
+                continue
+            yield child
+            yield from walk(child)
+
+    for stmt in own_statements(fn):
+        yield stmt
+        yield from walk(stmt)
+
+
+def _tracer_eval(fn: FunctionInfo, pkg: Package) -> TracerTaint:
+    ev = TracerTaint(fn, pkg)
+    ev.tainted = set(fn.tracer_locals) | set(fn.tainted_params)
+    return ev
+
+
+def _device_eval(fn: FunctionInfo, pkg: Package) -> DeviceTaint:
+    ev = DeviceTaint(fn, pkg)
+    ev.tainted = set(fn.device_locals) | set(fn.device_params)
+    ev.jit_names = dict(fn.jit_locals)
+    return ev
+
+
+def _tainted_names(ev, expr) -> List[str]:
+    names = []
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and node.id in ev.tainted \
+                and node.id not in names:
+            names.append(node.id)
+    return names
+
+
+# ---------------------------------------------------------------------
+# prng-reuse
+# ---------------------------------------------------------------------
+
+_KEY_PRODUCERS = frozenset({
+    "jax.random.PRNGKey", "jax.random.key", "jax.random.split",
+    "jax.random.fold_in", "jax.random.wrap_key_data",
+})
+
+
+@rule("prng-reuse",
+      "a PRNG key is consumed more than once (or re-consumed every "
+      "loop iteration)")
+def check_prng_reuse(pkg: Package, mod: ModuleInfo):
+    """Tracks names bound from ``jax.random.PRNGKey`` / ``split`` /
+    ``fold_in`` within each function.  A key passed to two consuming
+    calls — or created outside a loop and consumed inside it — yields
+    correlated samples; ``jax.random.split`` it instead.  Parameters
+    count as keys once ``jax.random.*`` consumes them.
+    """
+    for fn in mod.functions:
+        yield from _check_prng_fn(pkg, mod, fn)
+
+
+def _check_prng_fn(pkg: Package, mod: ModuleInfo, fn: FunctionInfo):
+    keys: Dict[str, Tuple[Tuple[int, ...], int]] = {}  # name -> (loops, uses)
+    param_uses: Dict[str, int] = {}
+    findings = []
+
+    def bind(name: str, loops):
+        keys[name] = (loops, 0)
+
+    def bind_target(target, loops):
+        if isinstance(target, ast.Name):
+            bind(target.id, loops)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                bind_target(el, loops)
+
+    def consume(name: str, node, loops, via_random: bool,
+                deriving: bool):
+        if name in keys:
+            bound_loops, uses = keys[name]
+            if uses >= 1:
+                findings.append(Finding(
+                    "prng-reuse", mod.path, node.lineno, node.col_offset,
+                    f"PRNG key '{name}' is consumed more than once — "
+                    f"derive fresh keys with jax.random.split/fold_in"))
+            elif len(loops) > len(bound_loops) and not deriving:
+                # split/fold_in INSIDE the loop is the derivation idiom
+                # (fold_in(base, i) / key, sub = split(key)) — only
+                # direct sampling from an outer key is the bug
+                findings.append(Finding(
+                    "prng-reuse", mod.path, node.lineno, node.col_offset,
+                    f"PRNG key '{name}' was created outside this loop "
+                    f"but is consumed inside it — every iteration "
+                    f"reuses the same randomness"))
+            keys[name] = (bound_loops, uses + 1)
+        elif via_random and name in fn.all_params:
+            param_uses[name] = param_uses.get(name, 0) + 1
+            if param_uses[name] == 2:
+                findings.append(Finding(
+                    "prng-reuse", mod.path, node.lineno, node.col_offset,
+                    f"PRNG key parameter '{name}' is consumed by two "
+                    f"jax.random calls — split it first"))
+
+    def handle_call(call: ast.Call, loops):
+        name = pkg.full_name(mod, fn, call.func)
+        via_random = bool(name and name.startswith("jax.random.")
+                          and name not in ("jax.random.PRNGKey",
+                                           "jax.random.key"))
+        deriving = name in ("jax.random.split", "jax.random.fold_in")
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            inner = arg.value if isinstance(arg, ast.Starred) else arg
+            if isinstance(inner, ast.Name):
+                if inner.id in keys or via_random:
+                    consume(inner.id, call, loops, via_random, deriving)
+
+    def is_key_expr(value) -> bool:
+        if isinstance(value, ast.Call):
+            name = pkg.full_name(mod, fn, value.func)
+            return name in _KEY_PRODUCERS
+        if isinstance(value, ast.Subscript):
+            base = value.value
+            return isinstance(base, ast.Name) and base.id in keys
+        return False
+
+    def scan_calls(node, loops):
+        if isinstance(node, _FN_NODES):
+            return  # nested defs consume in their own scope
+        if isinstance(node, ast.Call):
+            handle_call(node, loops)
+        inner = loops + (id(node),) if isinstance(node, _COMP_NODES) \
+            else loops
+        for child in ast.iter_child_nodes(node):
+            scan_calls(child, inner)
+
+    def walk_stmt(stmt, loops):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        is_loop = isinstance(stmt, (ast.For, ast.AsyncFor, ast.While))
+        inner_loops = loops + (id(stmt),) if is_loop else loops
+        for expr in _stmt_exprs(stmt):
+            # a For header evaluates once, outside the loop it opens; a
+            # While test re-evaluates every iteration
+            depth = loops if (isinstance(stmt, (ast.For, ast.AsyncFor))
+                              and expr is stmt.iter) else inner_loops
+            scan_calls(expr, depth)
+        if isinstance(stmt, ast.Assign) and is_key_expr(stmt.value):
+            for tgt in stmt.targets:
+                bind_target(tgt, loops)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)) \
+                and is_key_expr(stmt.iter):
+            bind_target(stmt.target, inner_loops)
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.stmt):
+                walk_stmt(child, inner_loops)
+
+    for stmt in own_statements(fn):
+        walk_stmt(stmt, ())
+    return findings
+
+
+def _stmt_exprs(stmt) -> List[ast.expr]:
+    """The expressions evaluated by this statement itself (not by its
+    nested sub-statements)."""
+    out = []
+    for field, value in ast.iter_fields(stmt):
+        if isinstance(value, ast.expr):
+            out.append(value)
+        elif isinstance(value, list):
+            out += [v for v in value if isinstance(v, ast.expr)]
+    return out
+
+
+# ---------------------------------------------------------------------
+# tracer-branch
+# ---------------------------------------------------------------------
+
+@rule("tracer-branch",
+      "Python if/while branches on a traced value inside jit-compiled "
+      "code")
+def check_tracer_branch(pkg: Package, mod: ModuleInfo):
+    """Inside functions reachable from a ``jax.jit``/``shard_map``
+    entry point, a Python ``if``/``while``/conditional expression whose
+    test involves a traced value either fails to trace or silently
+    bakes one branch into the compiled program.  Shape/dtype/None
+    guards (``x.shape[0] > 1``, ``x is None``) are static and stay
+    quiet; use ``jnp.where``/``lax.cond`` for value-dependent control
+    flow.
+    """
+    for fn in mod.functions:
+        if not fn.jit_reachable:
+            continue
+        ev = _tracer_eval(fn, pkg)
+        for node in own_nodes(fn):
+            test = None
+            kind = None
+            if isinstance(node, (ast.If, ast.While)):
+                test, kind = node.test, (
+                    "if" if isinstance(node, ast.If) else "while")
+            elif isinstance(node, ast.IfExp):
+                test, kind = node.test, "conditional expression"
+            elif isinstance(node, ast.comprehension):
+                for cond in node.ifs:
+                    if ev.taint(cond):
+                        yield Finding(
+                            "tracer-branch", mod.path, cond.lineno,
+                            cond.col_offset,
+                            "comprehension filter on a traced value "
+                            "inside jit-compiled code")
+                continue
+            if test is None or not ev.taint(test):
+                continue
+            names = _tainted_names(ev, test)
+            what = f" ({', '.join(names)})" if names else ""
+            yield Finding(
+                "tracer-branch", mod.path, test.lineno, test.col_offset,
+                f"Python {kind} branches on a traced value{what} inside "
+                f"jit-compiled code — use jnp.where/lax.cond, or mark "
+                f"the argument static")
+
+
+# ---------------------------------------------------------------------
+# host-sync
+# ---------------------------------------------------------------------
+
+_SYNC_CASTS = frozenset({"float", "int", "bool", "complex"})
+_NP_SINKS = frozenset({"numpy.asarray", "numpy.array"})
+
+
+@rule("host-sync",
+      "a device value is synced to the host inside a loop (or inside "
+      "jit-traced code)")
+def check_host_sync(pkg: Package, mod: ModuleInfo):
+    """``.item()``, ``float()``/``int()``/``bool()``, ``np.asarray()``
+    and ``jax.device_get`` on device values block on a device->host
+    round trip.  Once per epoch that is fine; inside a loop (including
+    comprehensions) it serializes the hot path — fetch the whole tree
+    once with ``jax.device_get`` instead.  Inside jit-traced code the
+    same calls are trace errors and are flagged at any depth.
+    """
+    evals: Dict[FunctionInfo, DeviceTaint] = {}
+    tracer_evals: Dict[FunctionInfo, TracerTaint] = {}
+    for node, scope, depth in walk_with_context(mod):
+        if not isinstance(node, ast.Call) or scope is None:
+            continue
+        ev = evals.get(scope)
+        if ev is None:
+            ev = evals[scope] = _device_eval(scope, pkg)
+        name = pkg.full_name(mod, scope, node.func)
+        in_jit = scope.jit_reachable
+        tev = None
+        if in_jit:
+            tev = tracer_evals.get(scope)
+            if tev is None:
+                tev = tracer_evals[scope] = _tracer_eval(scope, pkg)
+
+        def arg_hits(evaluator):
+            return any(evaluator.taint(a) for a in node.args)
+
+        if name == "jax.device_get":
+            if depth > 0:
+                yield Finding(
+                    "host-sync", mod.path, node.lineno, node.col_offset,
+                    "jax.device_get inside a loop — hoist it out and "
+                    "fetch the whole tree in one transfer")
+            elif in_jit:
+                yield Finding(
+                    "host-sync", mod.path, node.lineno, node.col_offset,
+                    "jax.device_get inside jit-traced code")
+        elif name in _SYNC_CASTS or name in _NP_SINKS:
+            label = name.replace("numpy.", "np.")
+            if depth > 0 and arg_hits(ev):
+                yield Finding(
+                    "host-sync", mod.path, node.lineno, node.col_offset,
+                    f"{label}() on a device value inside a loop — each "
+                    f"call blocks on a device->host transfer; "
+                    f"jax.device_get the whole tree once instead")
+            elif in_jit and arg_hits(tev):
+                yield Finding(
+                    "host-sync", mod.path, node.lineno, node.col_offset,
+                    f"{label}() on a traced value inside jit-compiled "
+                    f"code — this fails (or constant-folds) at trace "
+                    f"time")
+        elif (isinstance(node.func, ast.Attribute)
+              and node.func.attr == "item" and not node.args):
+            base = node.func.value
+            if depth > 0 and ev.taint(base):
+                yield Finding(
+                    "host-sync", mod.path, node.lineno, node.col_offset,
+                    ".item() on a device value inside a loop — each "
+                    "call is a blocking device->host sync")
+            elif in_jit and tev is not None and tev.taint(base):
+                yield Finding(
+                    "host-sync", mod.path, node.lineno, node.col_offset,
+                    ".item() on a traced value inside jit-compiled code")
+
+
+# ---------------------------------------------------------------------
+# donated-reuse
+# ---------------------------------------------------------------------
+
+@rule("donated-reuse",
+      "an argument buffer is read after being donated to a jit call")
+def check_donated_reuse(pkg: Package, mod: ModuleInfo):
+    """Arguments at ``donate_argnums`` positions are invalidated by the
+    call: XLA reuses their memory for the outputs.  Reading the old
+    name afterwards (or on the next loop iteration, when the call did
+    not rebind it) sees deleted buffers.  Rebind the donated name from
+    the call's results, as in ``params, opt = step(params, opt, x)``.
+    """
+    for fn in mod.functions:
+        yield from _check_donated_fn(pkg, mod, fn)
+
+
+def _check_donated_fn(pkg: Package, mod: ModuleInfo, fn: FunctionInfo):
+    ev = _device_eval(fn, pkg)
+    findings = []
+
+    def as_dotted(expr) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            return expr.id
+        parts = dotted_parts(expr)
+        if parts is not None and len(parts) == 2 and parts[0] == "self":
+            return f"self.{parts[1]}"
+        return None
+
+    def loads_in(stmt) -> Set[str]:
+        names = set()
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Load):
+                names.add(node.id)
+            elif isinstance(node, ast.Attribute) \
+                    and isinstance(node.ctx, ast.Load):
+                d = as_dotted(node)
+                if d is not None:
+                    names.add(d)
+        return names
+
+    def targets_in(stmt) -> Set[str]:
+        names = set()
+        nodes = []
+        if isinstance(stmt, ast.Assign):
+            nodes = stmt.targets
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            nodes = [stmt.target]
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            nodes = [stmt.target]
+        elif isinstance(stmt, ast.With):
+            nodes = [i.optional_vars for i in stmt.items
+                     if i.optional_vars is not None]
+        for tnode in nodes:
+            for node in ast.walk(tnode):
+                d = as_dotted(node)
+                if d is not None:
+                    names.add(d)
+        # walrus assignments anywhere in the statement
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.NamedExpr):
+                d = as_dotted(node.target)
+                if d is not None:
+                    names.add(d)
+        return names
+
+    def donations_in(stmt) -> Dict[str, ast.Call]:
+        out = {}
+        for node in ast.walk(stmt):
+            if isinstance(node, _FN_NODES):
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            meta = ev.jit_value(node.func)
+            if meta is None or not meta.donate:
+                continue
+            for pos in meta.donate:
+                if pos < len(node.args):
+                    d = as_dotted(node.args[pos])
+                    if d is not None:
+                        out[d] = node
+        return out
+
+    def process_block(stmts, donated: Dict[str, ast.Call]):
+        block_donates: Dict[str, ast.Call] = {}
+        block_assigns: Set[str] = set()
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            sub_blocks = [getattr(stmt, f, None)
+                          for f in ("body", "orelse", "finalbody")]
+            sub_stmts = [s for block in sub_blocks if block
+                         for s in block]
+            if isinstance(stmt, ast.Try):
+                for handler in stmt.handlers:
+                    sub_stmts += handler.body
+            own = [n for n in _stmt_exprs(stmt)]
+            # 1. loads of currently-donated names -> findings
+            if sub_stmts:
+                header_loads = set()
+                for expr in own:
+                    header_loads |= loads_in(expr)
+            else:
+                header_loads = loads_in(stmt)
+            for name in sorted(header_loads):
+                if name in donated:
+                    findings.append(Finding(
+                        "donated-reuse", mod.path, stmt.lineno,
+                        stmt.col_offset,
+                        f"'{name}' was donated to the jit call on line "
+                        f"{donated[name].lineno} and must not be read "
+                        f"afterwards — rebind it from the call's "
+                        f"outputs"))
+                    del donated[name]  # report once
+            # 2. this statement's own donations
+            if sub_stmts:
+                stmt_donations = {}
+                for expr in own:
+                    stmt_donations.update(donations_in(expr))
+            else:
+                stmt_donations = donations_in(stmt)
+            # 3. recurse into sub-blocks
+            if sub_stmts:
+                is_loop = isinstance(stmt, (ast.For, ast.AsyncFor,
+                                            ast.While))
+                sub_don, sub_asn = process_block(sub_stmts, donated)
+                if is_loop:
+                    for name, call in sub_don.items():
+                        if name not in sub_asn:
+                            findings.append(Finding(
+                                "donated-reuse", mod.path, call.lineno,
+                                call.col_offset,
+                                f"'{name}' is donated inside this loop "
+                                f"but never rebound — the next "
+                                f"iteration reads a deleted buffer"))
+                block_donates.update(sub_don)
+                block_assigns |= sub_asn
+            # 4. record donations, then clear assigned names
+            donated.update(stmt_donations)
+            block_donates.update(stmt_donations)
+            assigns = targets_in(stmt)
+            block_assigns |= assigns
+            for name in assigns:
+                donated.pop(name, None)
+        return block_donates, block_assigns
+
+    process_block(own_statements(fn), {})
+    return findings
+
+
+# ---------------------------------------------------------------------
+# retrace-risk
+# ---------------------------------------------------------------------
+
+_NONHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.SetComp,
+                ast.DictComp)
+
+
+@rule("retrace-risk",
+      "a jit pattern that forces re-compilation on every call")
+def check_retrace_risk(pkg: Package, mod: ModuleInfo):
+    """Flags (a) ``jax.jit(f)(x)`` compiled inline — the compile cache
+    dies with the expression, so every execution recompiles; (b)
+    ``jax.jit`` created inside a loop — same failure, one wrapper (and
+    cache) per iteration; (c) ``static_argnums``/``static_argnames``/
+    ``donate_argnums`` that are not literals — the linter (and the
+    reader) can no longer see the contract; (d) list/dict/set literals
+    passed at a static position — non-hashable statics raise, and a
+    fresh literal per call retraces even when hashable.
+    """
+    evals: Dict[FunctionInfo, DeviceTaint] = {}
+    for node, scope, depth in walk_with_context(mod):
+        if not isinstance(node, ast.Call):
+            continue
+        name = pkg.full_name(mod, scope, node.func)
+        if name in JIT_WRAPPERS:
+            if depth > 0:
+                yield Finding(
+                    "retrace-risk", mod.path, node.lineno,
+                    node.col_offset,
+                    f"{name.rsplit('.', 1)[-1]} created inside a loop "
+                    f"— each iteration builds a fresh wrapper and "
+                    f"compile cache; build it once outside")
+            if not jit_meta_from_call(node).constant_opts:
+                yield Finding(
+                    "retrace-risk", mod.path, node.lineno,
+                    node.col_offset,
+                    "static_argnums/static_argnames/donate_argnums "
+                    "should be literal ints/strings so the trace "
+                    "contract is auditable")
+        if isinstance(node.func, ast.Call):
+            inner = pkg.full_name(mod, scope, node.func.func)
+            if inner in JIT_WRAPPERS:
+                yield Finding(
+                    "retrace-risk", mod.path, node.lineno,
+                    node.col_offset,
+                    f"{inner.rsplit('.', 1)[-1]}(...)(...) compiles "
+                    f"inline and discards the cache — every call "
+                    f"recompiles; bind the jitted function once")
+        # (d) non-hashable literals at static positions
+        if scope is not None:
+            ev = evals.get(scope)
+            if ev is None:
+                ev = evals[scope] = _device_eval(scope, pkg)
+            meta = ev.jit_value(node.func)
+            if meta is not None and meta.static_nums:
+                for pos in meta.static_nums:
+                    if pos < len(node.args) and isinstance(
+                            node.args[pos], _NONHASHABLE):
+                        yield Finding(
+                            "retrace-risk", mod.path,
+                            node.args[pos].lineno,
+                            node.args[pos].col_offset,
+                            f"non-hashable literal at static argument "
+                            f"position {pos} — static args must be "
+                            f"hashable, and a fresh value per call "
+                            f"forces a retrace")
+
+
+# ---------------------------------------------------------------------
+# debug-leftover
+# ---------------------------------------------------------------------
+
+_DEBUG_CALLS = frozenset({
+    "jax.debug.print", "jax.debug.breakpoint", "breakpoint",
+    "pdb.set_trace", "ipdb.set_trace",
+})
+
+
+@rule("debug-leftover",
+      "a debugging call (jax.debug.print / breakpoint) left in "
+      "production code")
+def check_debug_leftover(pkg: Package, mod: ModuleInfo):
+    """``jax.debug.print``/``jax.debug.breakpoint`` serialize host
+    callbacks into the compiled program (and breakpoints hang headless
+    runs).  Fine while debugging; never in merged code.
+    """
+    for node, scope, _depth in walk_with_context(mod):
+        if not isinstance(node, ast.Call):
+            continue
+        name = pkg.full_name(mod, scope, node.func)
+        if name in _DEBUG_CALLS:
+            yield Finding(
+                "debug-leftover", mod.path, node.lineno, node.col_offset,
+                f"leftover {name}() — remove before merging")

@@ -121,6 +121,17 @@ class TrainConfig:
     checkpoint_keep_last: int = 0
     # ... plus every K-th epoch regardless of age (0 = none)
     checkpoint_keep_every: int = 0
+    # retrace budget for the jitted update step, asserted by a
+    # RetraceGuard after every training step: compiling more than this
+    # many times per run means input shapes/dtypes are churning (each
+    # recompile stalls the learner for seconds on TPU).  0 = count and
+    # report in the metrics jsonl, but never raise
+    max_update_compiles: int = 0
+    # arm a HostTransferGuard around the learner process and report
+    # device->host transfer counts per epoch in the metrics jsonl
+    # (counts jax.device_get / np.asarray / np.array on device values;
+    # a growing count means a host sync crept into the hot loop)
+    host_transfer_guard: bool = True
     # league-lite: schedule PAST-SELF opponents into generation jobs.
     # {past_epochs: K} samples one opponent seat per league job from
     # the retained checkpoints of the last K epochs; optional prob
@@ -151,7 +162,8 @@ class TrainConfig:
                 f"unknown transfer_dtype {self.transfer_dtype!r}")
         for key in ("columnar_cache_mb", "checkpoint_keep_last",
                     "checkpoint_keep_every", "device_replay_mb",
-                    "device_replay_episodes", "updates_per_epoch"):
+                    "device_replay_episodes", "updates_per_epoch",
+                    "max_update_compiles"):
             if getattr(self, key) < 0:
                 raise ValueError(f"{key} must be >= 0")
         if self.device_replay not in ("auto", "on", "off"):

@@ -42,6 +42,7 @@ try:
 except ImportError:  # pragma: no cover
     psutil = None
 
+from .analysis.guards import HostTransferGuard, RetraceGuard
 from .batch import make_batch
 from .connection import MultiProcessJobExecutor
 from .environment import make_env, prepare_env
@@ -400,13 +401,24 @@ class Trainer:
         self.prefetcher = None
         self.timers = SectionTimers()
         self.trace = TraceWindow(self.args.get("profile_dir") or "")
+        # compile accounting for the hot-path programs: the update step
+        # must compile once per run (per mesh shape); anything more is
+        # shape churn.  max_update_compiles > 0 turns the count into a
+        # hard assertion checked after every step
+        self.retrace_guard = RetraceGuard(
+            max_compiles=self.args.get("max_update_compiles", 0),
+            name="update_step")
+        self.transfer_guard = (
+            HostTransferGuard()
+            if self.args.get("host_transfer_guard", True) else None)
 
         if self.num_params > 0:
             self.optimizer = make_optimizer(
                 self.default_lr * self.data_cnt_ema)
             self.params = model.params
             self.opt_state = self.optimizer.init(self.params)
-            self.update_step = self._build_update_step()
+            self.update_step = self.retrace_guard.wrap(
+                self._build_update_step())
             self._maybe_restore_train_state()
             if self.multihost:
                 self._sync_initial_state()
@@ -422,12 +434,14 @@ class Trainer:
             # + Adam — the host passes three scalars (multi-host
             # instead assembles global batches from the local rings
             # and runs the global update_step)
-            self._replay_step = make_replay_update_step(
-                self.device_replay, self.model, self.loss_cfg,
-                self.optimizer, self.compute_dtype,
-                batch_size=self.args["batch_size"],
-                mesh=self.train_mesh, params=self.params,
-                fsdp=self.train_fsdp, seed=self.args.get("seed", 0))
+            self._replay_step = self.retrace_guard.wrap(
+                make_replay_update_step(
+                    self.device_replay, self.model, self.loss_cfg,
+                    self.optimizer, self.compute_dtype,
+                    batch_size=self.args["batch_size"],
+                    mesh=self.train_mesh, params=self.params,
+                    fsdp=self.train_fsdp,
+                    seed=self.args.get("seed", 0)))
         # the host batcher farm exists only when the device-resident
         # path is off: skipping it frees host cores for actors
         self.batcher = None
@@ -741,6 +755,10 @@ class Trainer:
                 # drain arrivals even when idling at the cap, so the
                 # pending queue can't overflow and shed episodes
                 replay.ingest(max_episodes=8)
+            # ring growth re-lays the buffers (new shapes): those
+            # recompiles are designed, so they widen the retrace
+            # budget instead of tripping it
+            self.retrace_guard.allowance = replay.growths
             if cap and batch_cnt >= cap:
                 # epoch budget spent: idle until the learner asks for
                 # the snapshot, releasing host CPU to the actors
@@ -776,6 +794,8 @@ class Trainer:
         if self.device_replay is not None:
             with self.timers.section("ingest"):
                 self.device_replay.ingest(max_episodes=8)
+            # growth recompiles are designed: widen the retrace budget
+            self.retrace_guard.allowance = self.device_replay.growths
             with self.timers.section("batch_wait"):
                 local = self.device_replay.sample(self.local_batch_size)
                 return self._global_from_local_shards(local)
@@ -839,6 +859,11 @@ class Trainer:
             return None
         batch_cnt, metric_acc = result
 
+        # ONE device->host fetch for the whole epoch's metrics: each
+        # per-step dict holds device scalars, and float()-ing them one
+        # by one would block on a separate transfer per value per step
+        # (jaxlint host-sync)
+        metric_acc = jax.device_get(metric_acc)
         data_cnt = sum(float(m["dcnt"]) for m in metric_acc)
         loss_sum = {}
         for m in metric_acc:
@@ -869,6 +894,14 @@ class Trainer:
         self.last_metrics = {k: l / data_cnt for k, l in loss_sum.items()}
         for name, v in prof.items():
             self.last_metrics[f"profile_{name}_sec"] = v["sec"]
+        # guard counters (see analysis.guards): the compile count is
+        # cumulative and must stay flat after the first epoch; host
+        # transfers are the per-epoch delta and must not grow with
+        # the step count
+        self.last_metrics["retrace_count"] = self.retrace_guard.compiles
+        if self.transfer_guard is not None:
+            self.last_metrics["host_transfers"] = \
+                self.transfer_guard.snapshot()
         if self.device_replay is not None:
             self.last_metrics["replay_episodes"] = \
                 self.device_replay.episodes_seen
@@ -908,6 +941,10 @@ class Trainer:
 
     def run(self):
         print("waiting training")
+        if self.transfer_guard is not None:
+            # armed for the trainer's whole life: transfer counts are
+            # reported per epoch from train() via snapshot()
+            self.transfer_guard.__enter__()
         try:
             # warmup wait lives inside try so the finally block owns
             # trace.close() on every exit path, including warmup-abort
@@ -964,6 +1001,8 @@ class Trainer:
             traceback.print_exc()
             self.failure = exc
         finally:
+            if self.transfer_guard is not None:
+                self.transfer_guard.__exit__(None, None, None)
             self.trace.close()  # this thread owns the profiler trace
 
 

@@ -194,6 +194,10 @@ class DeviceReplay:
         self.write_ptr = 0         # next slot (FIFO ring)
         self.size = 0              # filled slots
         self.episodes_seen = 0
+        self.growths = 0           # T_max growth count: each one is a
+        #                            LEGITIMATE recompile of the fused
+        #                            step (the trainer widens its
+        #                            RetraceGuard budget by this)
 
         # server thread -> trainer thread handoff
         self.pending = deque()
@@ -546,6 +550,7 @@ class DeviceReplay:
                 return jnp.pad(rows, pad)
             return tree_map(leaf, buf)
 
+        # jaxlint: disable=retrace-risk -- growth doubles T_max, so this compiles O(log T) times per run and the shapes differ every time anyway
         self.buffers = jax.jit(
             relayout, donate_argnums=0, out_shardings=self._rep
         )(self.buffers)
@@ -556,6 +561,7 @@ class DeviceReplay:
         self.write_ptr = kept % new_cap
         self.capacity = new_cap
         self.t_max = new_t_max
+        self.growths += 1
         self._state_dirty = True
         self._build_jits()
 

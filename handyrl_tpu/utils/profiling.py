@@ -8,7 +8,11 @@ is first-class:
     epoch and fed into the metrics jsonl;
   * ``TraceWindow`` — captures a ``jax.profiler`` trace of a span of
     update steps into ``profile_dir`` (viewable in TensorBoard /
-    Perfetto), armed by the ``profile_dir`` config key.
+    Perfetto), armed by the ``profile_dir`` config key;
+  * ``RetraceGuard`` / ``HostTransferGuard`` (re-exported from
+    :mod:`handyrl_tpu.analysis.guards`) — compile-count and
+    device->host transfer accounting for the hot path, reported per
+    epoch in the metrics jsonl (see docs/static_analysis.md).
 """
 
 import time
@@ -16,6 +20,11 @@ from collections import defaultdict
 from contextlib import contextmanager
 
 import jax
+
+from ..analysis.guards import (  # noqa: F401  (observability surface)
+    HostTransferGuard,
+    RetraceGuard,
+)
 
 
 class SectionTimers:

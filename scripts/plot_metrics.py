@@ -144,6 +144,26 @@ def plot(epochs, out_prefix):
         fig.savefig(out_prefix + "_loss.png", dpi=120, bbox_inches="tight")
         print(f"wrote {out_prefix}_loss.png")
 
+    # guard counters (analysis.guards via the metrics jsonl):
+    # retrace_count is cumulative and must stay FLAT after epoch 1;
+    # host_transfers is the per-epoch delta and must not grow with the
+    # step count — a rising line on either is a hot-path regression
+    guard_keys = [k for k in ("retrace_count", "host_transfers")
+                  if any(k in e for e in epochs)]
+    if guard_keys:
+        fig, ax = plt.subplots(figsize=(8, 5))
+        for k in guard_keys:
+            pts = [(x, e[k]) for x, e in zip(xs, epochs) if k in e]
+            if pts:
+                ax.plot(*zip(*pts), label=k, marker=".")
+        ax.set_xlabel("epoch")
+        ax.set_ylabel("count")
+        ax.legend()
+        ax.grid(alpha=0.3)
+        fig.savefig(out_prefix + "_guards.png", dpi=120,
+                    bbox_inches="tight")
+        print(f"wrote {out_prefix}_guards.png")
+
     # generation stats (mean +- std band)
     pts = [(x, e["generation_mean"], e.get("generation_std", 0.0))
            for x, e in zip(xs, epochs) if "generation_mean" in e]

@@ -1,0 +1,12 @@
+"""Fixture: ordinary host-side printing is not a debug leftover."""
+
+import jax
+
+
+@jax.jit
+def step(x):
+    return x * 2
+
+
+def report(epoch, loss):
+    print(f"epoch {epoch}: loss = {loss:.3f}")  # host logging is fine

@@ -1,0 +1,14 @@
+"""Fixture: debug calls left in the code."""
+
+import jax
+
+
+@jax.jit
+def step(x):
+    jax.debug.print("x = {}", x)
+    return x * 2
+
+
+def inspect(x):
+    breakpoint()
+    return x

@@ -1,0 +1,10 @@
+"""Fixture: suppressed debug print (a sanctioned trace hook)."""
+
+import jax
+
+
+@jax.jit
+def step(x):
+    # jaxlint: disable=debug-leftover -- NaN tripwire, enabled by a debug config flag
+    jax.debug.print("step input norm = {}", x.sum())
+    return x * 2

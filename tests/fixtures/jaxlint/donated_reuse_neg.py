@@ -1,0 +1,22 @@
+"""Fixture: the correct donate pattern — rebind from the outputs."""
+
+import jax
+import jax.numpy as jnp
+
+
+def make_step():
+    return jax.jit(lambda p, o, b: (p, o), donate_argnums=(0, 1))
+
+
+def rebind_each_step(params, opt_state, batches):
+    step = make_step()
+    for batch in batches:
+        params, opt_state = step(params, opt_state, batch)
+    return params, opt_state
+
+
+def norm_before_donate(params, opt_state, batch):
+    step = make_step()
+    norm = jnp.linalg.norm(params)  # read BEFORE donation: fine
+    params, opt_state = step(params, opt_state, batch)
+    return params, opt_state, norm

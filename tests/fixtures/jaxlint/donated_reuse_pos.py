@@ -1,0 +1,22 @@
+"""Fixture: reading buffers after donating them to a jit call."""
+
+import jax
+import jax.numpy as jnp
+
+
+def make_step():
+    return jax.jit(lambda p, o, b: (p, o), donate_argnums=(0, 1))
+
+
+def use_after_donate(params, opt_state, batch):
+    step = make_step()
+    new_params, new_opt = step(params, opt_state, batch)
+    norm = jnp.linalg.norm(params)  # params buffer is already dead
+    return new_params, new_opt, norm
+
+
+def donate_in_loop(params, opt_state, batches):
+    step = make_step()
+    for batch in batches:
+        out = step(params, opt_state, batch)  # never rebinds params/opt
+    return out

@@ -1,0 +1,15 @@
+"""Fixture: suppressed donated-reuse (metadata-only access)."""
+
+import jax
+
+
+def make_step():
+    return jax.jit(lambda p, o, b: (p, o), donate_argnums=(0, 1))
+
+
+def shape_after_donate(params, opt_state, batch):
+    step = make_step()
+    new_params, new_opt = step(params, opt_state, batch)
+    # jaxlint: disable=donated-reuse -- debug logging of a dead buffer's repr only
+    print(repr(params))
+    return new_params, new_opt

@@ -1,0 +1,25 @@
+"""Fixture: device metrics accumulated on device, synced ONCE per
+epoch — the fixed learner pattern."""
+
+import jax
+import numpy as np
+
+
+def make_step():
+    return jax.jit(lambda p, b: (p, {"loss": b.sum()}))
+
+
+def epoch(params, batches):
+    step = make_step()
+    metrics = []
+    for batch in batches:
+        params, m = step(params, batch)
+        metrics.append(m)  # device values stay on device
+    metrics = jax.device_get(metrics)  # ONE transfer for the epoch
+    total = sum(float(m["loss"]) for m in metrics)
+    return params, total
+
+
+def host_loop(rows):
+    # float()/np.asarray on plain host data in loops is fine
+    return [float(np.asarray(r).mean()) for r in rows]

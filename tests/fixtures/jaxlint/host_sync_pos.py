@@ -1,0 +1,49 @@
+"""Fixture: per-step device->host syncs in an epoch loop — the pattern
+the learner's metric aggregation used to have."""
+
+import jax
+import numpy as np
+
+
+def make_step():
+    return jax.jit(lambda p, b: (p, {"loss": b.sum()}))
+
+
+def epoch_float_sync(params, batches):
+    step = make_step()
+    metrics = []
+    for batch in batches:
+        params, m = step(params, batch)
+        metrics.append(m)
+    # one blocking transfer per step's metrics dict:
+    return params, sum(float(m["loss"]) for m in metrics)
+
+
+def epoch_item_sync(params, batches):
+    step = make_step()
+    total = 0.0
+    for batch in batches:
+        params, m = step(params, batch)
+        total += m["loss"].item()  # blocking sync inside the hot loop
+    return params, total
+
+
+def epoch_device_get_sync(params, batches):
+    step = make_step()
+    out = []
+    for batch in batches:
+        params, m = step(params, batch)
+        out.append(jax.device_get(m))  # transfer per iteration
+    return params, out
+
+
+class Trainer:
+    def __init__(self):
+        self.update_step = jax.jit(lambda p, b: (p, {"loss": b.sum()}))
+
+    def epoch(self, params, batches):
+        acc = []
+        for batch in batches:
+            params, m = self.update_step(params, batch)
+            acc.append(np.asarray(m["loss"]))  # sync per step
+        return params, acc

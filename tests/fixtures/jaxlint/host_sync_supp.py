@@ -1,0 +1,17 @@
+"""Fixture: intentional per-iteration sync, suppressed with a reason."""
+
+import jax
+
+
+def make_step():
+    return jax.jit(lambda p, b: (p, b.sum()))
+
+
+def epoch_with_early_stop(params, batches, tol):
+    step = make_step()
+    for batch in batches:
+        params, loss = step(params, batch)
+        # jaxlint: disable=host-sync -- early-stop check needs the value each step
+        if float(loss) < tol:
+            break
+    return params

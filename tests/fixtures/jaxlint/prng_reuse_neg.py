@@ -1,0 +1,37 @@
+"""Fixture: correct key discipline — split/fold_in before reuse."""
+
+import jax
+
+
+def split_consume(seed):
+    key = jax.random.PRNGKey(seed)
+    k1, k2 = jax.random.split(key)
+    a = jax.random.uniform(k1, (3,))
+    b = jax.random.normal(k2, (3,))
+    return a + b
+
+
+def loop_fold(seed, steps):
+    base = jax.random.PRNGKey(seed)
+    out = []
+    for i in range(steps):
+        key = jax.random.fold_in(base, i)
+        out.append(jax.random.uniform(key, (3,)))
+    return out
+
+
+def split_carry(seed, steps):
+    key = jax.random.PRNGKey(seed)
+    out = []
+    for _ in range(steps):
+        key, sub = jax.random.split(key)  # the carry idiom
+        out.append(jax.random.uniform(sub, (3,)))
+    return out
+
+
+def rebind(seed):
+    key = jax.random.PRNGKey(seed)
+    a = jax.random.uniform(key, (3,))
+    key = jax.random.PRNGKey(seed + 1)
+    b = jax.random.normal(key, (3,))
+    return a + b

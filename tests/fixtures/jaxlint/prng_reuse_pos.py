@@ -1,0 +1,24 @@
+"""Fixture: the same PRNG key feeds two samplers (and a loop)."""
+
+import jax
+
+
+def double_consume(seed):
+    key = jax.random.PRNGKey(seed)
+    a = jax.random.uniform(key, (3,))
+    b = jax.random.normal(key, (3,))  # reuse: correlated streams
+    return a + b
+
+
+def loop_consume(seed, steps):
+    key = jax.random.PRNGKey(seed)
+    out = []
+    for _ in range(steps):
+        out.append(jax.random.uniform(key, (3,)))  # same draw each step
+    return out
+
+
+def param_consume(key):
+    noise = jax.random.normal(key, (3,))
+    scale = jax.random.uniform(key, ())  # key parameter reused
+    return noise * scale

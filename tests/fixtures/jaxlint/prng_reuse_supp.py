@@ -1,0 +1,11 @@
+"""Fixture: intentional key reuse, suppressed with a reason."""
+
+import jax
+
+
+def antithetic(seed):
+    key = jax.random.PRNGKey(seed)
+    a = jax.random.uniform(key, (3,))
+    # jaxlint: disable=prng-reuse -- antithetic pair wants identical draws
+    b = jax.random.uniform(key, (3,))
+    return a - b

@@ -1,0 +1,17 @@
+"""Fixture: jit built once, statics hashable — compiles once."""
+
+import jax
+
+
+def scale(x, factors):
+    return x * sum(factors)
+
+
+_scale_jit = jax.jit(scale, static_argnums=(1,))
+
+
+def apply(xs):
+    out = []
+    for x in xs:
+        out.append(_scale_jit(x, (1, 2, 3)))  # tuple: hashable static
+    return out

@@ -1,0 +1,25 @@
+"""Fixture: jit patterns that recompile on every call."""
+
+import jax
+
+
+def inline_jit(xs):
+    out = []
+    for x in xs:
+        # fresh wrapper + fresh cache per iteration, compiled inline
+        out.append(jax.jit(lambda a: a + 1)(x))
+    return out
+
+
+def scale(x, factors):
+    return x * sum(factors)
+
+
+def nonhashable_static(x):
+    jitted = jax.jit(scale, static_argnums=(1,))
+    return jitted(x, [1, 2, 3])  # list literal at a static position
+
+
+def opaque_options(x, nums):
+    jitted = jax.jit(scale, static_argnums=nums)  # non-literal options
+    return jitted(x, (1, 2))

@@ -1,0 +1,33 @@
+"""Fixture: static (shape/dtype/None) guards and device control flow
+inside jit — all legal."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def shape_guard(x):
+    if x.ndim >= 2 and x.shape[0] > 1:  # shapes are trace-time static
+        return jnp.sum(x, axis=0)
+    return x
+
+
+@jax.jit
+def none_guard(x, hidden=None):
+    if hidden is None:  # identity guards are static
+        return x
+    return x + hidden
+
+
+@functools.partial(jax.jit, static_argnums=1)
+def static_branch(x, mode):
+    if mode == "double":  # static_argnums: a Python value, not a tracer
+        return x * 2
+    return x
+
+
+@jax.jit
+def device_select(x):
+    return jnp.where(x > 0, x, -x)  # value-dependent, but traced

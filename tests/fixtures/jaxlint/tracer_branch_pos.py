@@ -1,0 +1,29 @@
+"""Fixture: Python control flow on traced values inside jit."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def relu_branch(x):
+    if x > 0:  # tracer in a Python if
+        return x
+    return -x
+
+
+def clip_loop(y):
+    while y.sum() > 1.0:  # tracer in a Python while, via call graph
+        y = y * 0.5
+    return y
+
+
+def step(x):
+    return clip_loop(x * 2)
+
+
+update = jax.jit(step)
+
+
+@jax.jit
+def pick(x, flag):
+    return x if flag else -x  # tracer in a conditional expression

@@ -1,0 +1,12 @@
+"""Fixture: suppressed tracer branch (e.g. deliberately concretized
+under jax.disable_jit in a debug harness)."""
+
+import jax
+
+
+@jax.jit
+def debug_clip(x):
+    # jaxlint: disable=tracer-branch -- only ever run under jax.disable_jit
+    if x > 10:
+        return x * 0
+    return x

@@ -1,0 +1,169 @@
+"""Runtime guard suite: RetraceGuard compile accounting (cache-size and
+signature-fallback paths, budget enforcement) and HostTransferGuard
+transfer counting (device hits, host passes, budget, restoration)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from handyrl_tpu.analysis.guards import (
+    HostTransferError,
+    HostTransferGuard,
+    RetraceError,
+    RetraceGuard,
+)
+
+
+def test_retrace_guard_stable_shapes_compile_once():
+    guard = RetraceGuard(name="step")
+    step = guard.wrap(jax.jit(lambda x: x * 2))
+    for _ in range(5):
+        step(jnp.ones(4))
+    assert guard.compiles == 1
+    assert guard.calls == 5
+
+
+def test_retrace_guard_counts_shape_churn():
+    guard = RetraceGuard(name="step")
+    step = guard.wrap(jax.jit(lambda x: x * 2))
+    step(jnp.ones(4))
+    step(jnp.ones(8))
+    step(jnp.ones((2, 4)))
+    assert guard.compiles == 3
+
+
+def test_retrace_guard_budget_raises_at_the_offending_call():
+    guard = RetraceGuard(max_compiles=1, name="step")
+    step = guard.wrap(jax.jit(lambda x: x + 1))
+    step(jnp.ones(4))
+    with pytest.raises(RetraceError, match="update_step|step"):
+        step(jnp.ones(5))
+
+
+def test_retrace_guard_counts_any_callable():
+    # signature counting needs no jit machinery: plain callables work
+    guard = RetraceGuard(name="plain")
+    fn = guard.wrap(lambda x, flag=False: x)
+    fn(np.ones(3))
+    fn(np.ones(3), flag=True)    # same shapes, new kwarg treedef
+    fn(np.ones((3, 1)))
+    assert guard.compiles == 3
+    fn(np.ones(3))
+    assert guard.compiles == 3   # seen before: no new "compile"
+
+
+def test_retrace_guard_allowance_exempts_designed_recompiles():
+    # the learner widens the budget by the replay ring's growth count:
+    # a designed T_max re-layout must not trip the assertion
+    guard = RetraceGuard(max_compiles=1, name="step")
+    step = guard.wrap(jax.jit(lambda x: x * 2))
+    step(jnp.ones(4))
+    guard.allowance = 1  # one ring growth happened
+    step(jnp.ones(8))    # the post-growth recompile: allowed
+    assert guard.compiles == 2
+    with pytest.raises(RetraceError):
+        step(jnp.ones(16))  # a THIRD shape is real churn again
+
+
+def test_retrace_guard_sampling_still_catches_persistent_churn():
+    # after the warmup window the signature is only sampled, but a
+    # persistent shape change is caught within SAMPLE_EVERY calls
+    from handyrl_tpu.analysis.guards import _GuardedJit
+
+    guard = RetraceGuard(name="step")
+    step = guard.wrap(jax.jit(lambda x: x + 1))
+    for _ in range(_GuardedJit.WARM_CALLS + 10):
+        step(jnp.ones(4))
+    assert guard.compiles == 1
+    for _ in range(_GuardedJit.SAMPLE_EVERY):
+        step(jnp.ones(8))  # churn begins past the warmup window
+    assert guard.compiles == 2
+
+
+def test_retrace_guard_sums_over_wrapped_fns():
+    guard = RetraceGuard(name="pair")
+    a = guard.wrap(jax.jit(lambda x: x + 1))
+    b = guard.wrap(jax.jit(lambda x: x - 1))
+    a(jnp.ones(2))
+    b(jnp.ones(2))
+    assert guard.compiles == 2
+
+
+def test_host_transfer_guard_cheap_on_big_host_lists():
+    # the probe is bounded: converting a large host list must not walk
+    # every element (the guard is armed process-wide in the learner)
+    import time
+
+    big = list(range(2_000_000))
+    with HostTransferGuard() as guard:
+        t0 = time.perf_counter()
+        np.array(big)
+        probe_overhead = time.perf_counter() - t0
+    assert guard.transfers == 0
+    # conversion itself dominates; just pin that we didn't add a
+    # python-level walk of all 2M elements (that costs ~100ms+)
+    t0 = time.perf_counter()
+    np.array(big)
+    bare = time.perf_counter() - t0
+    assert probe_overhead < bare * 3 + 0.05
+
+
+def test_host_transfer_guard_counts_device_syncs():
+    value = jax.jit(lambda x: x + 1)(jnp.ones(3))
+    with HostTransferGuard() as guard:
+        np.asarray(value)
+        np.array(value)
+        jax.device_get({"metrics": value})
+        np.asarray(np.ones(3))      # host array: free
+        np.array([1.0, 2.0])        # host list: free
+    assert guard.transfers == 3
+
+
+def test_host_transfer_guard_snapshot_deltas():
+    value = jnp.ones(3)
+    with HostTransferGuard() as guard:
+        np.asarray(value)
+        assert guard.snapshot() == 1
+        np.asarray(value)
+        np.asarray(value)
+        assert guard.snapshot() == 2
+        assert guard.snapshot() == 0
+
+
+def test_host_transfer_guard_budget():
+    value = jnp.ones(3)
+    with pytest.raises(HostTransferError):
+        with HostTransferGuard(max_transfers=1) as guard:
+            np.asarray(value)
+            np.asarray(value)
+    # the patch must be unwound even when the budget raised
+    assert np.asarray.__module__ == "numpy"
+
+
+def test_host_transfer_guard_restores_entry_points():
+    orig_asarray = np.asarray
+    orig_array = np.array
+    orig_get = jax.device_get
+    with HostTransferGuard():
+        assert np.asarray is not orig_asarray
+    assert np.asarray is orig_asarray
+    assert np.array is orig_array
+    assert jax.device_get is orig_get
+
+
+def test_host_transfer_guard_keeps_keyword_signatures():
+    # the patched entry points must accept the originals' documented
+    # keyword forms for their first argument
+    value = jnp.ones(3)
+    with HostTransferGuard() as guard:
+        assert np.array(object=[1, 2]).tolist() == [1, 2]
+        assert np.asarray(a=[3, 4]).tolist() == [3, 4]
+        assert jax.device_get(x=value).shape == (3,)
+    assert guard.transfers == 1  # only the device_get touched a jax array
+
+
+def test_host_transfer_guard_not_reentrant():
+    with HostTransferGuard() as guard:
+        with pytest.raises(RuntimeError, match="reentrant"):
+            guard.__enter__()

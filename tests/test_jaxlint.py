@@ -1,0 +1,189 @@
+"""jaxlint rule suite: every rule fires on its positive fixture, stays
+quiet on its negative, and obeys suppression comments — plus the CI
+gate itself (the whole package must lint clean).
+
+Fixture convention (tests/fixtures/jaxlint/): ``<rule>_pos.py`` must
+produce findings of exactly that rule, ``<rule>_neg.py`` and
+``<rule>_supp.py`` must produce none.  The fixtures are parsed, never
+imported."""
+
+import json
+import os
+
+import pytest
+
+from handyrl_tpu.analysis.jaxlint import lint_paths, lint_source, main
+from handyrl_tpu.analysis.rules import RULES
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "jaxlint")
+REPO_PACKAGE = os.path.join(
+    os.path.dirname(__file__), "..", "handyrl_tpu")
+
+RULE_IDS = sorted(RULES)
+
+
+def fixture(rule_id, kind):
+    path = os.path.join(FIXTURES, f"{rule_id.replace('-', '_')}_{kind}.py")
+    assert os.path.exists(path), f"missing fixture {path}"
+    return path
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_rule_fires_on_positive_fixture(rule_id):
+    findings = lint_paths([fixture(rule_id, "pos")])
+    assert findings, f"{rule_id} produced no findings on its positive"
+    assert all(f.rule == rule_id for f in findings), (
+        f"cross-rule noise on {rule_id}_pos: "
+        f"{[(f.rule, f.line) for f in findings]}")
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_rule_quiet_on_negative_fixture(rule_id):
+    findings = lint_paths([fixture(rule_id, "neg")])
+    assert findings == [], (
+        f"false positives on {rule_id}_neg: "
+        f"{[(f.rule, f.line, f.message) for f in findings]}")
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_rule_suppressed_with_reason(rule_id):
+    findings = lint_paths([fixture(rule_id, "supp")])
+    assert findings == [], (
+        f"suppression not honored on {rule_id}_supp: "
+        f"{[(f.rule, f.line) for f in findings]}")
+
+
+def test_every_positive_names_real_rules():
+    # the parametrized fixtures above cover exactly the registry
+    assert set(RULE_IDS) == {
+        "prng-reuse", "tracer-branch", "host-sync", "donated-reuse",
+        "retrace-risk", "debug-leftover"}
+
+
+# -- suppression machinery -------------------------------------------
+
+def test_bare_suppression_is_itself_reported():
+    src = (
+        "import jax\n"
+        "def f(x):\n"
+        "    jax.debug.print('{}', x)  # jaxlint: disable=debug-leftover\n"
+        "    return x\n")
+    findings = lint_source(src)
+    assert [f.rule for f in findings] == ["bare-suppression"]
+
+
+def test_suppression_on_previous_comment_line():
+    src = (
+        "import jax\n"
+        "def f(x):\n"
+        "    # jaxlint: disable=debug-leftover -- demo hook\n"
+        "    jax.debug.print('{}', x)\n"
+        "    return x\n")
+    assert lint_source(src) == []
+
+
+def test_trailing_code_does_not_extend_suppression_down():
+    # a same-line suppression must not silence the NEXT line
+    src = (
+        "import jax\n"
+        "def f(x):\n"
+        "    y = 1  # jaxlint: disable=debug-leftover -- only this line\n"
+        "    jax.debug.print('{}', x)\n"
+        "    return x + y\n")
+    findings = lint_source(src)
+    assert [f.rule for f in findings] == ["debug-leftover"]
+
+
+def test_skip_file():
+    src = (
+        "# jaxlint: skip-file -- generated\n"
+        "import jax\n"
+        "def f(x):\n"
+        "    jax.debug.print('{}', x)\n"
+        "    return x\n")
+    assert lint_source(src) == []
+
+
+def test_docstrings_mentioning_syntax_are_not_suppressions():
+    # only real comment tokens count: documentation of the suppression
+    # syntax inside a string/docstring must neither suppress nor be
+    # reported as a bare suppression
+    src = (
+        '"""Suppress with ``# jaxlint: disable=debug-leftover`` inline,\n'
+        'or skip a file with ``# jaxlint: skip-file`` up top."""\n'
+        "import jax\n"
+        "def f(x):\n"
+        "    jax.debug.print('{}', x)\n"
+        "    return x\n")
+    findings = lint_source(src)
+    assert [f.rule for f in findings] == ["debug-leftover"]
+
+
+def test_bare_skip_file_is_not_a_silent_bypass():
+    # a reason-less skip-file still skips the rules, but the bare
+    # suppression itself surfaces (and fails the CI gate)
+    src = (
+        "# jaxlint: skip-file\n"
+        "import jax\n"
+        "def f(x):\n"
+        "    jax.debug.print('{}', x)\n"
+        "    return x\n")
+    findings = lint_source(src)
+    assert [f.rule for f in findings] == ["bare-suppression"]
+
+
+def test_learner_metric_fix_regression():
+    """The exact pattern fixed in learner.train(): per-step float() on
+    device metrics flags; the single jax.device_get fetch does not."""
+    broken = (
+        "import jax\n"
+        "class Trainer:\n"
+        "    def __init__(self):\n"
+        "        self.update_step = jax.jit(lambda p, b: (p, {'d': b}))\n"
+        "    def train(self, params, batches):\n"
+        "        acc = []\n"
+        "        for b in batches:\n"
+        "            params, m = self.update_step(params, b)\n"
+        "            acc.append(m)\n"
+        "        return sum(float(m['d']) for m in acc)\n")
+    fixed = broken.replace(
+        "        return sum(float(m['d']) for m in acc)\n",
+        "        acc = jax.device_get(acc)\n"
+        "        return sum(float(m['d']) for m in acc)\n")
+    assert any(f.rule == "host-sync" for f in lint_source(broken))
+    assert lint_source(fixed) == []
+
+
+# -- CLI + CI gate ----------------------------------------------------
+
+def test_cli_json_output(capsys):
+    rc = main(["--json", fixture("debug-leftover", "pos")])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["total"] == len(out["findings"]) > 0
+    assert all(f["rule"] == "debug-leftover" for f in out["findings"])
+
+
+def test_cli_clean_exit(capsys):
+    rc = main([fixture("debug-leftover", "neg")])
+    assert rc == 0
+    assert "clean" in capsys.readouterr().out
+
+
+def test_cli_list_rules(capsys):
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule_id in RULE_IDS:
+        assert rule_id in out
+
+
+def test_cli_unknown_rule(capsys):
+    assert main(["--select", "no-such-rule", FIXTURES]) == 2
+
+
+def test_repo_lints_clean():
+    """The CI gate, enforced locally too: the shipped package must have
+    zero unsuppressed findings."""
+    findings = lint_paths([REPO_PACKAGE])
+    assert findings == [], "\n".join(
+        f"{f.location}: [{f.rule}] {f.message}" for f in findings)

@@ -4,8 +4,12 @@ The TPU-native analog of running ``python main.py --train`` for a couple
 of epochs on TicTacToe with tiny settings — exercises the whole async
 runtime: job assignment, model serving, gather fan-in, episode intake,
 recency sampling, batcher farm, jitted updates, checkpointing, and
-shutdown."""
+shutdown.  The update step trains under a RetraceGuard with a budget of
+ONE compile (``max_update_compiles``): any shape churn introduced by a
+future batching change fails this test at the offending step instead of
+surfacing as a silent TPU slowdown."""
 
+import json
 import os
 import pickle
 
@@ -39,6 +43,12 @@ def test_local_training_two_epochs(tmp_path, monkeypatch):
             "policy_target": "VTRACE",
             "value_target": "VTRACE",
             "seed": 1,
+            # retrace/host-sync guards armed for real: the update step
+            # may compile exactly once, and every epoch must report the
+            # guard counters into the metrics jsonl
+            "max_update_compiles": 1,
+            "host_transfer_guard": True,
+            "metrics_path": "metrics.jsonl",
         },
         "worker_args": {"num_parallel": 2, "server_address": ""},
     }
@@ -49,6 +59,24 @@ def test_local_training_two_epochs(tmp_path, monkeypatch):
     learner.run()  # returns when epochs reached and workers drained
 
     assert learner.model_epoch == 2
+
+    # exactly ONE compile of the (device-replay) update step across
+    # both epochs — with max_update_compiles=1, a second compile would
+    # already have raised RetraceError inside the trainer thread, and
+    # the trainer records failures instead of crashing the learner, so
+    # assert both ends
+    assert learner.trainer.failure is None
+    assert learner.trainer.retrace_guard.compiles == 1
+    assert learner.trainer.retrace_guard.calls > 0
+
+    # guard counters flow into the metrics jsonl, one record per epoch
+    with open("metrics.jsonl") as f:
+        records = [json.loads(line) for line in f if line.strip()]
+    assert len(records) == 2
+    for record in records:
+        assert record["retrace_count"] == 1
+        assert record["host_transfers"] >= 1  # the epoch snapshot sync
+
     assert os.path.exists("models/1.ckpt")
     assert os.path.exists("models/2.ckpt")
     assert os.path.exists("models/latest.ckpt")
