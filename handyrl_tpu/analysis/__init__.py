@@ -9,17 +9,25 @@ trace-stable as the codebase grows:
     tracers inside jitted code, no host syncs in hot loops, no
     use-after-donation, no retrace-forcing jit patterns, no leftover
     debug calls.  CLI: ``python -m handyrl_tpu.analysis.jaxlint``.
+  * :mod:`handyrl_tpu.analysis.shardlint` + ``shardrules`` — the
+    sharding/collective-consistency layer (``--shard``): an abstract
+    interpreter over the same package model that validates mesh axes,
+    ``PartitionSpec`` consistency, collective/shard_map agreement,
+    implicit resharding at jit boundaries, multihost control-flow
+    divergence, and divisibility guarantees.
   * :mod:`handyrl_tpu.analysis.guards` — runtime context managers that
-    measure what the linter cannot prove: ``RetraceGuard`` (compile
-    counts of the update step) and ``HostTransferGuard``
-    (device->host transfer counts per epoch).
+    measure what the linters cannot prove: ``RetraceGuard`` (compile
+    counts of the update step), ``HostTransferGuard`` (device->host
+    transfer counts per epoch), and ``ShardingContractGuard``
+    (resharding copies at the update step's boundary).
 
 Guard classes are re-exported lazily (PEP 562) so importing the
 analysis package — e.g. from the jaxlint CLI — never pulls in jax.
 """
 
 _GUARD_EXPORTS = ("RetraceGuard", "RetraceError", "HostTransferGuard",
-                  "HostTransferError")
+                  "HostTransferError", "ShardingContractGuard",
+                  "ShardingContractError")
 
 __all__ = list(_GUARD_EXPORTS)
 

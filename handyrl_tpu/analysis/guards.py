@@ -1,7 +1,8 @@
-"""Runtime guards: retrace and host-transfer accounting.
+"""Runtime guards: retrace, host-transfer, and resharding accounting.
 
-The static rules in :mod:`.rules` prove what they can from source; the
-two guards here measure what only a running program knows:
+The static rules in :mod:`.rules`/:mod:`.shardrules` prove what they
+can from source; the guards here measure what only a running program
+knows:
 
   * :class:`RetraceGuard` wraps jitted callables and counts retraces —
     the learner's update step must compile exactly once per run per
@@ -18,8 +19,17 @@ two guards here measure what only a running program knows:
     C-level syncs (``.item()``, ``float()`` on an array) cannot be
     intercepted from Python — the static ``host-sync`` rule covers
     those paths instead.
+  * :class:`ShardingContractGuard` wraps jitted callables and counts
+    RESHARDING at the call boundary: the first call fixes the
+    per-argument sharding contract (per abstract signature), and any
+    later call whose leaf arrives laid out differently is an implicit
+    reshard — XLA silently copies the array onto the expected layout
+    before the program runs, defeating donation and doubling the
+    argument's HBM.  The static ``implicit-reshard`` rule catches the
+    cases provable from source; this guard catches the rest (shardings
+    threaded through config and checkpoints).
 
-Both are near-zero-cost (an isinstance check / an integer bump per
+All are near-zero-cost (an isinstance check / an integer bump per
 event) and run armed in production: the learner feeds their per-epoch
 deltas into the metrics jsonl, so a regression is visible on the same
 plots as the loss curves.
@@ -37,6 +47,10 @@ class RetraceError(RuntimeError):
 
 class HostTransferError(RuntimeError):
     """More device->host transfers than the armed budget allows."""
+
+
+class ShardingContractError(RuntimeError):
+    """More resharding copies at a jit boundary than the budget."""
 
 
 class _GuardedJit:
@@ -146,6 +160,131 @@ class RetraceGuard:
                 f"(budget {budget}) over {self.calls} calls "
                 f"— input shapes/dtypes are churning; pad batches to "
                 f"fixed shapes or mark the varying argument static")
+
+
+class _ShardedCall:
+    """Callable proxy that checks one jitted fn's sharding contract.
+
+    Each argument treedef carries a per-leaf contract that LATCHES on
+    the first COMMITTED sharding seen at that leaf; a later committed
+    leaf laid out differently is an implicit reshard — XLA copies it
+    onto the compiled program's layout before running, and on donated
+    arguments the copy defeats the donation.  Two deliberate skips
+    keep the count honest:
+
+      * uncommitted values (host numpy, fresh un-placed jnp results —
+        ``committed`` is False) have no layout of their own; the jit's
+        first placement of them — e.g. the freshly ``optimizer.init``-ed
+        state on the learner's first step — is designed
+        initialization, not a resharding copy.  On a single device
+        everything stays uncommitted and there is nothing to reshard,
+        so the guard is inert there by construction;
+      * a NEW treedef is a different program (its own compile, its own
+        contract), not a reshard of the old one — while a shape-only
+        change (the replay ring's T_max growth) keeps the contract,
+        and its re-laid buffers legitimately keep their shardings.
+
+    Shardings are read BEFORE the call (donated buffers are dead
+    after).  Limitation, documented: an input that arrives on the
+    WRONG layout from its very first committed call latches that
+    layout and stays quiet here — proving the intended layout from
+    source is the static ``implicit-reshard`` rule's job.
+    """
+
+    WARM_CALLS = _GuardedJit.WARM_CALLS
+    SAMPLE_EVERY = _GuardedJit.SAMPLE_EVERY
+
+    def __init__(self, guard, fn):
+        self._guard = guard
+        self._fn = fn
+        self._contracts = {}
+        self._calls = 0
+        self.copies = 0
+
+    def _check(self, args, kwargs):
+        leaves, treedef = jax.tree.flatten((args, kwargs))
+        contract = self._contracts.get(treedef)
+        if contract is None or len(contract) != len(leaves):
+            contract = self._contracts[treedef] = [None] * len(leaves)
+        mismatched = 0
+        for i, leaf in enumerate(leaves):
+            sharding = getattr(leaf, "sharding", None)
+            if sharding is None \
+                    or not getattr(leaf, "committed", False):
+                continue
+            if contract[i] is None:
+                contract[i] = sharding
+            elif contract[i] != sharding:
+                mismatched += 1
+        if mismatched:
+            self._guard._note(mismatched, self)
+
+    def __call__(self, *args, **kwargs):
+        self._calls += 1
+        if (self._calls <= self.WARM_CALLS
+                or self._calls % self.SAMPLE_EVERY == 0):
+            self._check(args, kwargs)
+        return self._fn(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
+
+
+class ShardingContractGuard:
+    """Resharding-copy accounting over one or more jitted callables.
+
+    ::
+
+        guard = ShardingContractGuard(name="update_step")
+        step = guard.wrap(make_sharded_update_step(...))
+        ...
+        guard.copies          # resharding copies observed so far
+        guard.snapshot()      # copies since the previous snapshot
+
+    The learner arms one around the update step and reports the
+    per-epoch delta as ``resharding_copies`` in the metrics jsonl: the
+    steady-state value is 0, because params/optimizer state are
+    donated back on their own shardings and batches arrive staged onto
+    the batch sharding.  Any positive count means an input changed
+    layout mid-run — a silent device-to-device copy per step, exactly
+    the Podracer failure mode shardlint's ``implicit-reshard`` rule
+    catches statically.  ``max_copies > 0`` turns the count into a
+    hard assertion (:class:`ShardingContractError`) raised at the
+    offending call.  Sampling matches :class:`RetraceGuard`: every
+    call during warmup, then one in SAMPLE_EVERY.
+    """
+
+    def __init__(self, max_copies: int = 0, name: str = "jit"):
+        self.max_copies = int(max_copies or 0)
+        self.name = name
+        self._last_snapshot = 0
+        self._wrapped = []
+
+    def wrap(self, fn):
+        """Wrap a jitted callable; returns the checking proxy."""
+        proxy = _ShardedCall(self, fn)
+        self._wrapped.append(proxy)
+        return proxy
+
+    @property
+    def copies(self) -> int:
+        return sum(proxy.copies for proxy in self._wrapped)
+
+    def _note(self, mismatched: int, proxy: "_ShardedCall"):
+        proxy.copies += mismatched
+        if self.max_copies and self.copies > self.max_copies:
+            raise ShardingContractError(
+                f"{self.name}: {self.copies} resharding copies "
+                f"(budget {self.max_copies}) — an argument's sharding "
+                f"changed mid-run, so XLA inserts a silent copy (and "
+                f"defeats donation) on every call; re-stage the input "
+                f"on the sharding the jit was built with")
+
+    def snapshot(self) -> int:
+        """Copies since the previous snapshot (per-epoch delta)."""
+        delta = self.copies - self._last_snapshot
+        self._last_snapshot = self.copies
+        return delta
 
 
 class HostTransferGuard:

@@ -8,8 +8,16 @@ Usage::
 
     python -m handyrl_tpu.analysis.jaxlint handyrl_tpu/
     python -m handyrl_tpu.analysis.jaxlint --json handyrl_tpu/
+    python -m handyrl_tpu.analysis.jaxlint --shard handyrl_tpu/
+    python -m handyrl_tpu.analysis.jaxlint --sarif handyrl_tpu/
     python -m handyrl_tpu.analysis.jaxlint --list-rules
     handyrl-jaxlint handyrl_tpu/            # console-script entry
+
+``--shard`` additionally runs the sharding/collective-consistency rule
+set (:mod:`.shardrules` — mesh-axis validity, implicit resharding,
+multihost divergence); ``--sarif`` emits SARIF 2.1.0 for GitHub code
+scanning; ``--exclude`` drops path prefixes (e.g. test fixtures) from
+directory scans.
 
 Exit status: 0 when clean, 1 when any finding survives suppression,
 2 on usage/IO errors.
@@ -121,18 +129,33 @@ class Suppressions:
         ]
 
 
-def _iter_py_files(paths: List[str]):
+def _excluded(path: str, exclude: Optional[List[str]]) -> bool:
+    if not exclude:
+        return False
+    norm = os.path.normpath(path)
+    for prefix in exclude:
+        p = os.path.normpath(prefix)
+        if norm == p or norm.startswith(p + os.sep):
+            return True
+    return False
+
+
+def _iter_py_files(paths: List[str], exclude: Optional[List[str]] = None):
     for path in paths:
         if os.path.isfile(path):
-            yield path
+            if not _excluded(path, exclude):
+                yield path
         elif os.path.isdir(path):
             for root, dirs, files in os.walk(path):
                 dirs[:] = sorted(
                     d for d in dirs
-                    if d not in ("__pycache__", ".git"))
+                    if d not in ("__pycache__", ".git")
+                    and not _excluded(os.path.join(root, d), exclude))
                 for name in sorted(files):
-                    if name.endswith(".py"):
-                        yield os.path.join(root, name)
+                    full = os.path.join(root, name)
+                    if name.endswith(".py") \
+                            and not _excluded(full, exclude):
+                        yield full
         else:
             raise FileNotFoundError(path)
 
@@ -157,7 +180,7 @@ def _module_name(path: str, roots: List[str]) -> str:
     return ".".join(parts)
 
 
-def load_package(paths: List[str]):
+def load_package(paths: List[str], exclude: Optional[List[str]] = None):
     """Parse every .py under ``paths`` into a Package + suppressions.
 
     Returns ``(package, suppressions_by_path, errors)`` where errors
@@ -165,7 +188,7 @@ def load_package(paths: List[str]):
     """
     roots = [p for p in paths if os.path.isdir(p)]
     modules, suppressions, errors = [], {}, []
-    for path in _iter_py_files(paths):
+    for path in _iter_py_files(paths, exclude):
         try:
             with open(path, encoding="utf-8") as f:
                 source = f.read()
@@ -178,18 +201,31 @@ def load_package(paths: List[str]):
     return Package(modules), suppressions, errors
 
 
+def active_registry(shard: bool = False) -> Dict[str, "object"]:
+    """The rule registry in force: jaxlint's base rules, plus the
+    shardlint rules with ``shard=True``."""
+    if not shard:
+        return dict(RULES)
+    from .shardrules import SHARD_RULES
+
+    return {**RULES, **SHARD_RULES}
+
+
 def lint_paths(paths: List[str],
-               select: Optional[List[str]] = None) -> List[Finding]:
+               select: Optional[List[str]] = None,
+               shard: bool = False,
+               exclude: Optional[List[str]] = None) -> List[Finding]:
     """Run the (selected) rules over ``paths``; returns surviving
     findings sorted by location."""
-    package, suppressions, errors = load_package(paths)
+    package, suppressions, errors = load_package(paths, exclude)
     findings = [
         Finding("parse-error", path, 1, 0, f"cannot parse: {msg}")
         for path, msg in errors
     ]
     compute_tracer_taint(package)
     compute_device_summaries(package)
-    active = [RULES[r] for r in (select or sorted(RULES))]
+    registry = active_registry(shard)
+    active = [registry[r] for r in (select or sorted(registry))]
     for mod in package.modules.values():
         supp = suppressions[mod.path]
         if supp.skip_file:
@@ -208,19 +244,21 @@ def lint_paths(paths: List[str],
 
 
 def lint_source(source: str, name: str = "<string>",
-                select: Optional[List[str]] = None) -> List[Finding]:
+                select: Optional[List[str]] = None,
+                shard: bool = False) -> List[Finding]:
     """Lint one in-memory module (test/fixture helper)."""
     module = ModuleInfo(name, name, source)
     package = Package([module])
     compute_tracer_taint(package)
     compute_device_summaries(package)
+    registry = active_registry(shard)
     supp = Suppressions(source, name)
     findings: List[Finding] = []
     if supp.skip_file:
         findings.extend(supp.bare_findings())
     else:
-        for rule_id in (select or sorted(RULES)):
-            for finding in RULES[rule_id].check(package, module):
+        for rule_id in (select or sorted(registry)):
+            for finding in registry[rule_id].check(package, module):
                 if not supp.covers(finding.rule, finding.line):
                     findings.append(finding)
         findings.extend(supp.bare_findings())
@@ -258,9 +296,53 @@ def _print_json(findings: List[Finding], file=None):
     print(file=file or sys.stdout)
 
 
-def _print_rules():
-    for rule_id in sorted(RULES):
-        rule = RULES[rule_id]
+def _print_sarif(findings: List[Finding], registry, file=None):
+    """SARIF 2.1.0 — the schema GitHub code scanning ingests, so CI
+    lint findings render as inline PR annotations."""
+    rule_ids = sorted({f.rule for f in findings} | set(registry))
+    rules_meta = []
+    for rule_id in rule_ids:
+        rule = registry.get(rule_id)
+        summary = rule.summary if rule is not None else {
+            "bare-suppression": "a suppression comment with no reason",
+            "parse-error": "a file the analyzer cannot parse",
+        }.get(rule_id, rule_id)
+        meta = {"id": rule_id,
+                "shortDescription": {"text": summary}}
+        if rule is not None and rule.doc:
+            meta["fullDescription"] = {
+                "text": " ".join(rule.doc.split())}
+        rules_meta.append(meta)
+    json.dump({
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "handyrl-jaxlint",
+                "informationUri":
+                    "https://github.com/handyrl-tpu/handyrl-tpu"
+                    "/blob/main/docs/static_analysis.md",
+                "rules": rules_meta,
+            }},
+            "results": [{
+                "ruleId": f.rule,
+                "level": "error",
+                "message": {"text": f.message},
+                "locations": [{"physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path.replace(os.sep, "/")},
+                    "region": {"startLine": f.line,
+                               "startColumn": f.col + 1},
+                }}],
+            } for f in findings],
+        }],
+    }, file or sys.stdout, indent=2)
+    print(file=file or sys.stdout)
+
+
+def _print_rules(registry):
+    for rule_id in sorted(registry):
+        rule = registry[rule_id]
         print(f"{rule_id}: {rule.summary}")
         doc = " ".join((rule.doc or "").split())
         if doc:
@@ -276,21 +358,36 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "(default: handyrl_tpu)")
     parser.add_argument("--json", action="store_true",
                         help="machine-readable JSON output")
+    parser.add_argument("--sarif", action="store_true",
+                        help="SARIF 2.1.0 output (GitHub code "
+                             "scanning annotations)")
+    parser.add_argument("--shard", action="store_true",
+                        help="also run the sharding/collective-"
+                             "consistency rules (shardlint)")
     parser.add_argument("--select", default=None,
                         help="comma-separated rule ids to run "
                              "(default: all)")
+    parser.add_argument("--exclude", action="append", default=None,
+                        metavar="PREFIX",
+                        help="path prefix to skip (repeatable), e.g. "
+                             "tests/fixtures")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule registry and exit")
     args = parser.parse_args(argv)
 
+    registry = active_registry(args.shard)
     if args.list_rules:
-        _print_rules()
+        _print_rules(registry)
         return 0
+    if args.json and args.sarif:
+        print("jaxlint: --json and --sarif are mutually exclusive",
+              file=sys.stderr)
+        return 2
 
     select = None
     if args.select:
         select = [r.strip() for r in args.select.split(",") if r.strip()]
-        unknown = [r for r in select if r not in RULES]
+        unknown = [r for r in select if r not in registry]
         if unknown:
             print(f"jaxlint: unknown rule(s): {', '.join(unknown)}",
                   file=sys.stderr)
@@ -298,12 +395,19 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     paths = args.paths or ["handyrl_tpu"]
     try:
-        findings = lint_paths(paths, select=select)
+        findings = lint_paths(paths, select=select, shard=args.shard,
+                              exclude=args.exclude)
     except FileNotFoundError as exc:
         print(f"jaxlint: no such path: {exc}", file=sys.stderr)
         return 2
 
-    if args.json:
+    if args.sarif:
+        _print_sarif(findings, registry)
+        if findings:
+            # stdout is redirected to the .sarif artifact in CI: a red
+            # job must still show WHAT failed in its log
+            _print_text(findings, file=sys.stderr)
+    elif args.json:
         _print_json(findings)
     else:
         _print_text(findings)

@@ -1,0 +1,701 @@
+"""shardlint — the abstract interpreter behind the sharding rules.
+
+jaxlint (PR 1) proves generic JAX invariants; this module is the layer
+it was blind to: the *mesh-parallel* contract of ``handyrl_tpu/parallel``.
+The rules in :mod:`.shardrules` need package-level answers to questions
+plain pattern matching cannot give:
+
+  * which mesh axes does this package actually construct?  (collected
+    from every ``Mesh(...)``/``jax.make_mesh(...)`` call, chasing
+    module-level axis-tuple constants like ``AXES = ("dp", "sp", "tp")``);
+  * what ``PartitionSpec`` does this expression denote?  (an abstract
+    sharding environment per function: names bound from ``P(...)``,
+    ``NamedSharding(mesh, ...)``, ``jax.device_put(x, s)``,
+    ``with_sharding_constraint`` and the return summaries of internal
+    builders like ``replicated``/``batch_sharding`` — looked up through
+    closures, so a nested ``stage_time`` sees its builder's bindings);
+  * which functions run inside a ``shard_map``/``pmap`` body, and over
+    which axes does that entry actually shard its inputs?  (worklist
+    over the jaxlint call graph, including function-valued arguments);
+  * which values are *host-divergent* — derived from
+    ``jax.process_index()`` — and which functions transitively perform
+    a collective?  (two package fixpoints with function-return and
+    ``self.*`` attribute summaries, the same monotone style as
+    :mod:`.astutil`'s device taint).
+
+Everything is stdlib ``ast`` only — like jaxlint, the analyzer never
+imports jax, so it runs in CI/pre-commit in milliseconds.  The
+abstraction is deliberately sound-where-it-matters: facts are only
+compared when BOTH sides resolve to literal specs, unknowns stay
+silent, and the per-line suppression syntax is the escape hatch for
+intentional violations.
+"""
+
+import ast
+from collections import deque
+from dataclasses import dataclass
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from .astutil import (
+    FunctionInfo,
+    ModuleInfo,
+    Package,
+    _TaintWalk,
+    _const_ints,
+    _walk_calls,
+    dotted_parts,
+)
+
+# -- name tables ------------------------------------------------------
+
+PSPEC_NAMES = frozenset({
+    "jax.sharding.PartitionSpec",
+    "jax.experimental.pjit.PartitionSpec",
+})
+NAMED_SHARDING_NAMES = frozenset({"jax.sharding.NamedSharding"})
+MESH_NAMES = frozenset({
+    "jax.sharding.Mesh", "jax.experimental.maps.Mesh",
+})
+MAKE_MESH_NAMES = frozenset({"jax.make_mesh", "jax.sharding.make_mesh"})
+SHARD_MAP_NAMES = frozenset({
+    "shard_map", "jax.experimental.shard_map.shard_map", "jax.shard_map",
+})
+JIT_NAMES = frozenset({
+    "jax.jit", "pjit", "jax.experimental.pjit.pjit",
+})
+CONSTRAINT_NAMES = frozenset({
+    "jax.lax.with_sharding_constraint",
+    "jax.experimental.pjit.with_sharding_constraint",
+})
+
+# collective -> positional index of its axis-name argument
+AXIS_COLLECTIVES: Dict[str, int] = {
+    "jax.lax.psum": 1, "jax.lax.pmean": 1, "jax.lax.pmax": 1,
+    "jax.lax.pmin": 1, "jax.lax.all_gather": 1, "jax.lax.all_to_all": 1,
+    "jax.lax.ppermute": 1, "jax.lax.pshuffle": 1,
+    "jax.lax.psum_scatter": 1, "jax.lax.axis_index": 0,
+}
+# collectives that only reduce (flagged by collective-mismatch when the
+# axis is unsharded); axis_index merely needs the axis bound
+REDUCING_COLLECTIVES = frozenset(AXIS_COLLECTIVES) - {"jax.lax.axis_index"}
+
+# cross-process collectives (no axis name; every process must call them
+# the same number of times in the same order)
+PROCESS_COLLECTIVES = frozenset({
+    "jax.experimental.multihost_utils.broadcast_one_to_all",
+    "jax.experimental.multihost_utils.sync_global_devices",
+    "jax.experimental.multihost_utils.process_allgather",
+    "jax.experimental.multihost_utils.assert_equal",
+})
+
+# host-divergent sources: a different value on every process
+DIVERGENT_SOURCES = frozenset({"jax.process_index"})
+
+
+# -- abstract facts ---------------------------------------------------
+
+@dataclass(frozen=True)
+class SpecFact:
+    """What the analyzer knows about one PartitionSpec/sharding value.
+
+    ``sig`` is the exact entry tuple (``None`` / axis string / tuple of
+    axis strings per dim) when every entry was a literal, else None.
+    ``axes`` is the set of axis names that MAY appear in the spec —
+    collected even when the full signature is not resolvable (e.g.
+    ``P(*spec)`` built from a list the strings were appended to).
+    """
+
+    sig: Optional[Tuple] = None
+    axes: FrozenSet[str] = frozenset()
+
+    @property
+    def exact(self) -> bool:
+        return self.sig is not None
+
+
+@dataclass
+class ShardJit:
+    """A jit value with a sharding contract (``in_shardings`` +
+    ``donate_argnums``), tracked so call sites can be checked against
+    it (the implicit-reshard rule)."""
+
+    donate: Tuple[int, ...] = ()
+    # one entry per positional argument; None = unknown at that slot
+    in_facts: Optional[List[Optional[SpecFact]]] = None
+    # a single (non-tuple) in_shardings value broadcast over all args
+    broadcast_fact: Optional[SpecFact] = None
+
+    def expected(self, pos: int) -> Optional[SpecFact]:
+        if self.in_facts is not None:
+            if pos < len(self.in_facts):
+                return self.in_facts[pos]
+            return None
+        return self.broadcast_fact
+
+
+def axis_literals(call: ast.Call) -> List[Tuple[str, ast.AST]]:
+    """Axis-name string literals syntactically inside a spec-like call:
+    direct constant args, elements of (possibly starred) tuple/list
+    args, and keyword values.  Deliberately shallow — strings inside
+    nested calls are NOT axis names."""
+    out: List[Tuple[str, ast.AST]] = []
+
+    def from_node(node):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            out.append((node.value, node))
+        elif isinstance(node, (ast.Tuple, ast.List)):
+            for el in node.elts:
+                from_node(el)
+        elif isinstance(node, ast.Starred):
+            from_node(node.value)
+        elif isinstance(node, ast.BinOp):  # [None] * 3 + ["tp"]
+            from_node(node.left)
+            from_node(node.right)
+
+    for arg in call.args:
+        from_node(arg)
+    for kw in call.keywords:
+        from_node(kw.value)
+    return out
+
+
+def spec_fact_from_pspec(call: ast.Call) -> SpecFact:
+    """Abstract a ``PartitionSpec(...)`` literal call."""
+    entries = []
+    exact = True
+    axes: Set[str] = set()
+    for arg in call.args:
+        if isinstance(arg, ast.Constant) and arg.value is None:
+            entries.append(None)
+        elif isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            entries.append(arg.value)
+            axes.add(arg.value)
+        elif isinstance(arg, (ast.Tuple, ast.List)) and all(
+                isinstance(el, ast.Constant)
+                and isinstance(el.value, str) for el in arg.elts):
+            names = tuple(el.value for el in arg.elts)
+            entries.append(names)
+            axes.update(names)
+        else:
+            exact = False
+            axes.update(name for name, _ in axis_literals(call))
+            break
+    if call.keywords:
+        exact = False
+        axes.update(name for name, _ in axis_literals(call))
+    return SpecFact(tuple(entries) if exact else None, frozenset(axes))
+
+
+UNKNOWN_AXES = None  # sentinel: "this shard_map's sharded axes are unknown"
+
+
+class ShardAnalysis:
+    """All package-level sharding facts, computed once per Package."""
+
+    MAX_PASSES = 5
+
+    def __init__(self, package: Package):
+        self.pkg = package
+        # declared mesh axes; None when the package constructs no mesh
+        self.mesh_axes: Optional[FrozenSet[str]] = None
+        self._mesh_axis_nodes: List[Tuple[ModuleInfo, str, ast.AST]] = []
+        # shard_map/pmap context
+        self.bound: Set[FunctionInfo] = set()          # runs inside one
+        self.sharded_axes: Dict[FunctionInfo, Optional[FrozenSet[str]]] = {}
+        # abstract sharding environments
+        self.env: Dict[FunctionInfo, Dict[str, object]] = {}
+        self.spec_returns: Dict[FunctionInfo, SpecFact] = {}
+        self.jit_returns: Dict[FunctionInfo, ShardJit] = {}
+        # host-divergence facts
+        self.divergent_locals: Dict[FunctionInfo, Set[str]] = {}
+        self.divergent_params: Dict[FunctionInfo, Set[str]] = {}
+        self.divergent_returns: Set[FunctionInfo] = set()
+        self.divergent_attrs: Dict[Tuple[str, str], Set[str]] = {}
+        # functions that transitively perform a collective
+        self.collective_fns: Set[FunctionInfo] = set()
+
+        self._collect_mesh_axes()
+        self._build_spec_envs()
+        self._propagate_shard_contexts()
+        self._compute_divergence()
+        self._compute_collective_summaries()
+
+    # -- mesh axes ----------------------------------------------------
+
+    def _module_axis_tuple(self, mod: ModuleInfo, name: str):
+        """A module-level ``NAME = ("dp", "sp", "tp")`` constant."""
+        for stmt in mod.tree.body:
+            if not isinstance(stmt, ast.Assign):
+                continue
+            targets = [t.id for t in stmt.targets
+                       if isinstance(t, ast.Name)]
+            if name not in targets:
+                continue
+            if isinstance(stmt.value, (ast.Tuple, ast.List)) and all(
+                    isinstance(el, ast.Constant)
+                    and isinstance(el.value, str)
+                    for el in stmt.value.elts):
+                return tuple(el.value for el in stmt.value.elts)
+        return None
+
+    def _axis_names_expr(self, mod: ModuleInfo, scope, expr):
+        """Axis names denoted by the axis-names argument of a Mesh
+        construction: a literal tuple/list of strings, a single string,
+        or a name resolving to a module-level tuple constant (possibly
+        imported from another scanned module)."""
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return (expr.value,)
+        if isinstance(expr, (ast.Tuple, ast.List)) and all(
+                isinstance(el, ast.Constant)
+                and isinstance(el.value, str) for el in expr.elts):
+            return tuple(el.value for el in expr.elts)
+        if isinstance(expr, ast.Name):
+            local = self._module_axis_tuple(mod, expr.id)
+            if local is not None:
+                return local
+            imp = mod.from_imports.get(expr.id)
+            if imp is not None:
+                target_mod = self.pkg.modules.get(imp[0])
+                if target_mod is not None:
+                    return self._module_axis_tuple(target_mod, imp[1])
+        return None
+
+    def _collect_mesh_axes(self):
+        axes: Set[str] = set()
+        seen_mesh = False
+        for mod in self.pkg.modules.values():
+            for scope, call in _walk_calls(mod):
+                name = self.pkg.full_name(mod, scope, call.func)
+                if name in MESH_NAMES or name in MAKE_MESH_NAMES:
+                    seen_mesh = True
+                    arg = None
+                    if len(call.args) >= 2:
+                        arg = call.args[1]
+                    for kw in call.keywords:
+                        if kw.arg == "axis_names":
+                            arg = kw.value
+                    names = (self._axis_names_expr(mod, scope, arg)
+                             if arg is not None else None)
+                    if names:
+                        axes.update(names)
+                elif name == "jax.pmap":
+                    for kw in call.keywords:
+                        if kw.arg == "axis_name" and isinstance(
+                                kw.value, ast.Constant) and isinstance(
+                                kw.value.value, str):
+                            seen_mesh = True
+                            axes.add(kw.value.value)
+        if seen_mesh and axes:
+            self.mesh_axes = frozenset(axes)
+
+    # -- sharding environments ---------------------------------------
+
+    def lookup(self, fn: Optional[FunctionInfo], name: str):
+        """Closure-chain lookup of an abstract sharding/jit fact."""
+        while fn is not None:
+            fact = self.env.get(fn, {}).get(name)
+            if fact is not None:
+                return fact
+            fn = fn.parent
+        return None
+
+    def resolve_spec(self, mod: ModuleInfo, scope, expr) \
+            -> Optional[SpecFact]:
+        """SpecFact denoted by an expression, or None when unknown."""
+        if isinstance(expr, ast.Name):
+            fact = self.lookup(scope, expr.id)
+            return fact if isinstance(fact, SpecFact) else None
+        if not isinstance(expr, ast.Call):
+            return None
+        name = self.pkg.full_name(mod, scope, expr.func)
+        if name in PSPEC_NAMES:
+            return spec_fact_from_pspec(expr)
+        if name in NAMED_SHARDING_NAMES:
+            spec_arg = expr.args[1] if len(expr.args) >= 2 else None
+            for kw in expr.keywords:
+                if kw.arg == "spec":
+                    spec_arg = kw.value
+            if spec_arg is not None:
+                return self.resolve_spec(mod, scope, spec_arg)
+            return None
+        if name == "jax.device_put" and len(expr.args) >= 2:
+            return self.resolve_spec(mod, scope, expr.args[1])
+        if name in CONSTRAINT_NAMES and len(expr.args) >= 2:
+            return self.resolve_spec(mod, scope, expr.args[1])
+        res = self.pkg.resolve_callee(mod, scope, expr.func)
+        if res is not None and res[0] == "fn":
+            return self.spec_returns.get(res[1])
+        return None
+
+    def _resolve_jit(self, mod: ModuleInfo, scope, expr) \
+            -> Optional[ShardJit]:
+        if isinstance(expr, ast.Name):
+            fact = self.lookup(scope, expr.id)
+            return fact if isinstance(fact, ShardJit) else None
+        if not isinstance(expr, ast.Call):
+            return None
+        name = self.pkg.full_name(mod, scope, expr.func)
+        if name in JIT_NAMES:
+            return self._jit_from_call(mod, scope, expr)
+        res = self.pkg.resolve_callee(mod, scope, expr.func)
+        if res is not None and res[0] == "fn":
+            return self.jit_returns.get(res[1])
+        return None
+
+    def _jit_from_call(self, mod, scope, call: ast.Call) -> ShardJit:
+        jit = ShardJit()
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                jit.donate = _const_ints(kw.value) or ()
+            elif kw.arg == "in_shardings":
+                if isinstance(kw.value, (ast.Tuple, ast.List)):
+                    jit.in_facts = [
+                        self.resolve_spec(mod, scope, el)
+                        for el in kw.value.elts
+                    ]
+                else:
+                    jit.broadcast_fact = self.resolve_spec(
+                        mod, scope, kw.value)
+        return jit
+
+    def _build_spec_envs(self):
+        """Per-function abstract environments, run to a package
+        fixpoint so builder-return summaries (``replicated`` ->
+        ``P()``) feed the environments that use them."""
+        for _ in range(self.MAX_PASSES):
+            changed = False
+            for fn in self.pkg.all_functions():
+                env: Dict[str, object] = {}
+                returns_spec: List[Optional[SpecFact]] = []
+                returns_jit: Optional[ShardJit] = None
+                mod = fn.module
+
+                def visit(node):
+                    nonlocal returns_jit
+                    if isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef,
+                                         ast.Lambda, ast.ClassDef)):
+                        return  # nested defs build their own env
+                    if isinstance(node, ast.Assign) \
+                            and len(node.targets) == 1 \
+                            and isinstance(node.targets[0], ast.Name):
+                        tgt = node.targets[0].id
+                        fact = self.resolve_spec(mod, fn, node.value)
+                        if fact is not None:
+                            env[tgt] = fact
+                        else:
+                            jit = self._resolve_jit(mod, fn, node.value)
+                            if jit is not None:
+                                env[tgt] = jit
+                    elif isinstance(node, ast.Return) \
+                            and node.value is not None:
+                        returns_spec.append(self.resolve_spec(
+                            mod, fn, node.value))
+                        if returns_jit is None:
+                            returns_jit = self._resolve_jit(
+                                mod, fn, node.value)
+                    for child in ast.iter_child_nodes(node):
+                        visit(child)
+
+                body = fn.node.body
+                if isinstance(fn.node, ast.Lambda):
+                    body = [ast.Expr(fn.node.body)]
+                for stmt in body:
+                    visit(stmt)
+                    # lambdas: the body expression IS the return
+                    if isinstance(fn.node, ast.Lambda) \
+                            and isinstance(stmt, ast.Expr):
+                        returns_spec.append(self.resolve_spec(
+                            mod, fn, stmt.value))
+
+                if env != self.env.get(fn, {}):
+                    self.env[fn] = env
+                    changed = True
+                known = [r for r in returns_spec if r is not None]
+                if known and len(known) == len(returns_spec):
+                    joined = known[0] if all(
+                        r == known[0] for r in known) else None
+                    if joined is not None \
+                            and self.spec_returns.get(fn) != joined:
+                        self.spec_returns[fn] = joined
+                        changed = True
+                if returns_jit is not None \
+                        and fn not in self.jit_returns:
+                    self.jit_returns[fn] = returns_jit
+                    changed = True
+            if not changed:
+                break
+
+    # -- shard_map / pmap contexts -----------------------------------
+
+    def _shard_entry_axes(self, mod, scope, call: ast.Call):
+        """The axes a shard_map call actually shards over: the union of
+        axis names in its (resolvable) in_specs.  None = unknown."""
+        in_specs = None
+        for kw in call.keywords:
+            if kw.arg == "in_specs":
+                in_specs = kw.value
+        if in_specs is None and len(call.args) >= 3:
+            in_specs = call.args[2]
+        if in_specs is None:
+            return UNKNOWN_AXES
+        elems = (in_specs.elts
+                 if isinstance(in_specs, (ast.Tuple, ast.List))
+                 else [in_specs])
+        axes: Set[str] = set()
+        for el in elems:
+            fact = self.resolve_spec(mod, scope, el)
+            if fact is None:
+                return UNKNOWN_AXES
+            axes.update(fact.axes)
+        return frozenset(axes)
+
+    def _callee_fns(self, fn: FunctionInfo):
+        """Directly-called internal functions + function-valued
+        arguments (higher-order propagation), within ``fn``'s body."""
+        out = []
+
+        def visit(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    continue
+                if isinstance(child, ast.Call):
+                    res = self.pkg.resolve_callee(
+                        fn.module, fn, child.func)
+                    if res is not None and res[0] == "fn":
+                        out.append(res[1])
+                    for arg in (list(child.args)
+                                + [kw.value for kw in child.keywords]):
+                        inner = (arg.value if isinstance(arg, ast.Starred)
+                                 else arg)
+                        if isinstance(inner, ast.Lambda):
+                            target = fn.module.by_node.get(inner)
+                            if target is not None:
+                                out.append(target)
+                        elif isinstance(inner, (ast.Name, ast.Attribute)):
+                            r = self.pkg.resolve_callee(
+                                fn.module, fn, inner)
+                            if r is not None and r[0] == "fn":
+                                out.append(r[1])
+                visit(child)
+
+        body = fn.node.body
+        if isinstance(fn.node, ast.Lambda):
+            body = [ast.Expr(fn.node.body)]
+        for stmt in body:
+            visit(stmt)
+        return out
+
+    def _propagate_shard_contexts(self):
+        work = deque()
+
+        def seed(fn, axes):
+            prev = self.sharded_axes.get(fn, frozenset())
+            if axes is UNKNOWN_AXES or prev is UNKNOWN_AXES:
+                merged = UNKNOWN_AXES
+            else:
+                merged = prev | axes
+            if fn not in self.bound or merged != prev:
+                self.bound.add(fn)
+                self.sharded_axes[fn] = merged
+                work.append(fn)
+
+        for mod in self.pkg.modules.values():
+            for scope, call in _walk_calls(mod):
+                name = self.pkg.full_name(mod, scope, call.func)
+                if name in SHARD_MAP_NAMES and call.args:
+                    target = call.args[0]
+                    fn = None
+                    if isinstance(target, ast.Lambda):
+                        fn = mod.by_node.get(target)
+                    else:
+                        res = self.pkg.resolve_callee(mod, scope, target)
+                        if res is not None and res[0] == "fn":
+                            fn = res[1]
+                    if fn is not None:
+                        seed(fn, self._shard_entry_axes(mod, scope, call))
+                elif name == "jax.pmap" and call.args:
+                    res = self.pkg.resolve_callee(mod, scope,
+                                                  call.args[0])
+                    axis = None
+                    for kw in call.keywords:
+                        if kw.arg == "axis_name" and isinstance(
+                                kw.value, ast.Constant) and isinstance(
+                                kw.value.value, str):
+                            axis = kw.value.value
+                    if res is not None and res[0] == "fn":
+                        seed(res[1], frozenset({axis}) if axis
+                             else UNKNOWN_AXES)
+
+        guard = 0
+        while work and guard < 10000:
+            guard += 1
+            fn = work.popleft()
+            axes = self.sharded_axes.get(fn, UNKNOWN_AXES)
+            for callee in self._callee_fns(fn):
+                seed(callee, axes)
+
+    # -- host divergence ---------------------------------------------
+
+    def _compute_divergence(self):
+        analysis = self
+
+        class DivergentTaint(_TaintWalk):
+            def __init__(self, fn, pkg):
+                super().__init__(fn, pkg)
+                self.tainted = (
+                    set(analysis.divergent_locals.get(fn, set()))
+                    | set(analysis.divergent_params.get(fn, set())))
+
+            def result_taint(self, name, resolution, call, arg_taints,
+                             kw_taints):
+                if name in DIVERGENT_SOURCES:
+                    return True
+                if name in AXIS_COLLECTIVES \
+                        or name in PROCESS_COLLECTIVES:
+                    # a collective's RESULT is synchronized across
+                    # processes by construction — divergence laundering
+                    # through broadcast is exactly the safe idiom
+                    return False
+                if resolution is not None and resolution[0] == "fn" \
+                        and resolution[1] in analysis.divergent_returns:
+                    return True
+                if resolution is not None and resolution[0] == "fn":
+                    return False  # summaries, not blanket propagation
+                func_tainted = (isinstance(call.func, ast.Attribute)
+                                and self.taint(call.func.value))
+                return (any(arg_taints) or any(kw_taints.values())
+                        or func_tainted)
+
+            def assign_attr(self, target, value, tainted):
+                parts = dotted_parts(target)
+                if parts is None or len(parts) != 2 \
+                        or parts[0] != "self" or self.fn.cls_name is None:
+                    return
+                if tainted:
+                    analysis.divergent_attrs.setdefault(
+                        (self.module.name, self.fn.cls_name),
+                        set()).add(parts[1])
+
+            def attr_taint(self, e):
+                parts = dotted_parts(e)
+                cls = self.fn.cls_name
+                scope = self.fn
+                while cls is None and scope is not None:
+                    scope = scope.parent
+                    cls = scope.cls_name if scope else None
+                if (parts is not None and len(parts) == 2
+                        and parts[0] == "self" and cls is not None
+                        and parts[1] in analysis.divergent_attrs.get(
+                            (self.module.name, cls), ())):
+                    return True
+                return super().attr_taint(e)
+
+        self._divergent_cls = DivergentTaint
+        for _ in range(self.MAX_PASSES):
+            changed = False
+            for fn in self.pkg.all_functions():
+                dt = DivergentTaint(fn, self.pkg).run()
+                if dt.tainted != self.divergent_locals.get(fn, set()):
+                    self.divergent_locals[fn] = set(dt.tainted)
+                    changed = True
+                if dt.return_tainted \
+                        and fn not in self.divergent_returns:
+                    self.divergent_returns.add(fn)
+                    changed = True
+                # argument flow into internal callees
+                for resolution, call, arg_taints, kw_taints in dt.calls:
+                    if resolution is None or resolution[0] != "fn":
+                        continue
+                    callee = resolution[1]
+                    params = callee.callable_params
+                    new: Set[str] = set()
+                    for idx, t in enumerate(arg_taints):
+                        if t and idx < len(params) \
+                                and not isinstance(call.args[idx],
+                                                   ast.Starred):
+                            new.add(params[idx])
+                    for kw, t in kw_taints.items():
+                        if t and kw in callee.all_params:
+                            new.add(kw)
+                    have = self.divergent_params.setdefault(callee, set())
+                    if new - have:
+                        have |= new
+                        changed = True
+            if not changed:
+                break
+
+    def divergence_eval(self, fn: FunctionInfo):
+        """A taint evaluator pre-seeded with ``fn``'s divergence
+        fixpoint, for rules to test arbitrary expressions."""
+        ev = self._divergent_cls(fn, self.pkg)
+        ev.tainted = (set(self.divergent_locals.get(fn, set()))
+                      | set(self.divergent_params.get(fn, set())))
+        return ev
+
+    # -- collective summaries ----------------------------------------
+
+    def _performs_collective_directly(self, fn: FunctionInfo) -> bool:
+        found = False
+
+        def visit(node):
+            nonlocal found
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    continue
+                if isinstance(child, ast.Call):
+                    name = self.pkg.full_name(fn.module, fn, child.func)
+                    if name in AXIS_COLLECTIVES \
+                            or name in PROCESS_COLLECTIVES:
+                        found = True
+                visit(child)
+
+        body = fn.node.body
+        if isinstance(fn.node, ast.Lambda):
+            body = [ast.Expr(fn.node.body)]
+        for stmt in body:
+            visit(stmt)
+        return found
+
+    def _compute_collective_summaries(self):
+        for fn in self.pkg.all_functions():
+            if self._performs_collective_directly(fn):
+                self.collective_fns.add(fn)
+        for _ in range(self.MAX_PASSES):
+            changed = False
+            for fn in self.pkg.all_functions():
+                if fn in self.collective_fns:
+                    continue
+                for callee in self._callee_fns(fn):
+                    if callee in self.collective_fns:
+                        self.collective_fns.add(fn)
+                        changed = True
+                        break
+            if not changed:
+                break
+
+    def is_collective_call(self, mod: ModuleInfo, scope,
+                           call: ast.Call) -> Optional[str]:
+        """The collective's display name when this call (transitively)
+        runs one, else None."""
+        name = self.pkg.full_name(mod, scope, call.func)
+        if name in AXIS_COLLECTIVES or name in PROCESS_COLLECTIVES:
+            return name
+        res = self.pkg.resolve_callee(mod, scope, call.func)
+        if res is not None and res[0] == "fn" \
+                and res[1] in self.collective_fns:
+            return res[1].qname.rsplit(":", 1)[-1]
+        return None
+
+
+def analyze(package: Package) -> ShardAnalysis:
+    """Compute (or fetch the cached) sharding analysis of a package."""
+    cached = getattr(package, "_shardlint_analysis", None)
+    if cached is None:
+        cached = ShardAnalysis(package)
+        package._shardlint_analysis = cached
+    return cached

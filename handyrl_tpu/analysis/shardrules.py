@@ -1,0 +1,440 @@
+"""shardlint's rule registry: six sharding/collective-consistency rules.
+
+Same shape as :mod:`.rules` — each rule is ``(Package, ModuleInfo) ->
+Iterable[Finding]`` under a stable kebab-case id (what suppression
+comments name), registered in ``SHARD_RULES`` and consuming the
+package-level facts of :mod:`.shardlint`.  None of them import jax.
+
+The rules, and the pod-scale failure mode each one prevents:
+
+  ``unknown-axis``          a ``PartitionSpec`` entry or collective
+                            ``axis_name`` that no constructed mesh
+                            declares -> trace-time NameError on the
+                            pod, never seen on single-chip CI.
+  ``axis-reuse``            the same mesh axis twice in one
+                            ``PartitionSpec`` -> invalid sharding
+                            (an axis cannot split two dims at once).
+  ``collective-mismatch``   a reduction over an axis the enclosing
+                            ``shard_map`` never shards -> silently
+                            multiplies replicated values by the axis
+                            size; or a collective with no enclosing
+                            axis-binding transform at all.
+  ``implicit-reshard``      an array whose inferred sharding disagrees
+                            with the ``in_shardings`` of the jit it
+                            feeds -> XLA inserts a silent full copy,
+                            and on a donated argument the donation is
+                            defeated (peak HBM doubles).
+  ``divergent-control``     ``jax.process_index()``-derived values
+                            deciding whether (or in what order) a
+                            collective runs -> multihost deadlock: one
+                            process waits in a collective its peers
+                            never enter.
+  ``unsynced-divisibility`` a batch/time dim constrained onto ``dp``/
+                            ``sp`` with no static divisibility guard
+                            in sight -> shapes that only break at pod
+                            axis sizes.
+"""
+
+import ast
+from typing import Dict, Optional
+
+from .astutil import ModuleInfo, Package
+from .rules import Finding, Rule, own_nodes
+from .shardlint import (
+    AXIS_COLLECTIVES,
+    CONSTRAINT_NAMES,
+    PSPEC_NAMES,
+    REDUCING_COLLECTIVES,
+    UNKNOWN_AXES,
+    ShardJit,
+    analyze,
+    axis_literals,
+)
+
+SHARD_RULES: Dict[str, Rule] = {}
+
+
+def shard_rule(rule_id: str, summary: str):
+    def deco(fn):
+        SHARD_RULES[rule_id] = Rule(rule_id, summary, fn.__doc__ or "", fn)
+        return fn
+    return deco
+
+
+def _module_calls(mod: ModuleInfo):
+    """Every call in the module with its enclosing FunctionInfo."""
+    from .astutil import _walk_calls
+
+    return _walk_calls(mod)
+
+
+def _collective_axes(call: ast.Call, axis_pos: int):
+    """Literal axis name(s) of a collective call: positional
+    ``axis_name`` slot or keyword, a string or tuple of strings."""
+    expr = None
+    if len(call.args) > axis_pos:
+        expr = call.args[axis_pos]
+    for kw in call.keywords:
+        if kw.arg == "axis_name":
+            expr = kw.value
+    if expr is None:
+        return []
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return [(expr.value, expr)]
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        return [(el.value, el) for el in expr.elts
+                if isinstance(el, ast.Constant)
+                and isinstance(el.value, str)]
+    return []
+
+
+# ---------------------------------------------------------------------
+# unknown-axis
+# ---------------------------------------------------------------------
+
+@shard_rule("unknown-axis",
+            "a PartitionSpec entry or collective axis_name that no "
+            "constructed mesh declares")
+def check_unknown_axis(pkg: Package, mod: ModuleInfo):
+    """Collects the package's declared mesh axes from every
+    ``Mesh(...)``/``jax.make_mesh(...)`` construction (chasing
+    module-level axis-tuple constants like ``AXES``), then requires
+    every literal ``PartitionSpec`` entry and collective ``axis_name``
+    to name one of them.  A stray axis traces fine on a single chip
+    (where every axis is size 1 or absent errors surface differently)
+    and explodes only on the pod.  Packages that build no mesh are
+    skipped — there is nothing to check against.
+    """
+    an = analyze(pkg)
+    if an.mesh_axes is None:
+        return
+    for scope, call in _module_calls(mod):
+        name = pkg.full_name(mod, scope, call.func)
+        if name in PSPEC_NAMES:
+            for axis, node in axis_literals(call):
+                if axis not in an.mesh_axes:
+                    yield Finding(
+                        "unknown-axis", mod.path, node.lineno,
+                        node.col_offset,
+                        f"PartitionSpec references axis '{axis}' but "
+                        f"the constructed mesh only declares "
+                        f"{sorted(an.mesh_axes)}")
+        elif name in AXIS_COLLECTIVES:
+            for axis, node in _collective_axes(
+                    call, AXIS_COLLECTIVES[name]):
+                if axis not in an.mesh_axes:
+                    yield Finding(
+                        "unknown-axis", mod.path, node.lineno,
+                        node.col_offset,
+                        f"{name.rsplit('.', 1)[-1]} over axis "
+                        f"'{axis}' but the constructed mesh only "
+                        f"declares {sorted(an.mesh_axes)}")
+
+
+# ---------------------------------------------------------------------
+# axis-reuse
+# ---------------------------------------------------------------------
+
+@shard_rule("axis-reuse",
+            "the same mesh axis appears twice in one PartitionSpec")
+def check_axis_reuse(pkg: Package, mod: ModuleInfo):
+    """A mesh axis can split at most one dimension of an array: ``P('dp',
+    'dp')`` (or ``P(('dp', 'tp'), 'dp')``) is rejected by jax at array
+    placement time — which on the learner means at first pod launch,
+    hours after the CI that never built an 8-chip mesh passed.
+    """
+    for scope, call in _module_calls(mod):
+        name = pkg.full_name(mod, scope, call.func)
+        if name not in PSPEC_NAMES:
+            continue
+        seen: Dict[str, object] = {}
+        for axis, node in axis_literals(call):
+            if axis in seen:
+                yield Finding(
+                    "axis-reuse", mod.path, node.lineno, node.col_offset,
+                    f"axis '{axis}' appears twice in one PartitionSpec "
+                    f"— a mesh axis can shard at most one dimension")
+            seen[axis] = node
+
+
+# ---------------------------------------------------------------------
+# collective-mismatch
+# ---------------------------------------------------------------------
+
+@shard_rule("collective-mismatch",
+            "a collective over an axis the enclosing shard_map never "
+            "shards (or with no axis-binding transform at all)")
+def check_collective_mismatch(pkg: Package, mod: ModuleInfo):
+    """Two ways a collective and its context disagree.  A reduction
+    (``psum``/``pmean``/...) over a mesh axis the enclosing
+    ``shard_map``'s ``in_specs`` never shard is almost always a bug:
+    the data is replicated along that axis, so the "sum" silently
+    multiplies by the axis size.  And a collective in code no
+    ``shard_map``/``pmap`` ever reaches has no bound axis at all —
+    it traces only by accident of test coverage.  Functions are
+    attributed to entries interprocedurally, through direct calls and
+    function-valued arguments.  Axes the mesh does not declare are
+    ``unknown-axis``'s findings, not this rule's.
+    """
+    an = analyze(pkg)
+    if an.mesh_axes is None:
+        return
+    for fn in mod.functions:
+        bound = fn in an.bound
+        sharded = an.sharded_axes.get(fn, UNKNOWN_AXES)
+        for node in own_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = pkg.full_name(mod, fn, node.func)
+            if name not in AXIS_COLLECTIVES:
+                continue
+            short = name.rsplit(".", 1)[-1]
+            for axis, anode in _collective_axes(
+                    node, AXIS_COLLECTIVES[name]):
+                if axis not in an.mesh_axes:
+                    continue  # unknown-axis reports that
+                if not bound:
+                    yield Finding(
+                        "collective-mismatch", mod.path, anode.lineno,
+                        anode.col_offset,
+                        f"{short} over axis '{axis}' outside any "
+                        f"shard_map/pmap that binds it — the axis name "
+                        f"is unbound at trace time")
+                elif (name in REDUCING_COLLECTIVES
+                        and sharded is not UNKNOWN_AXES
+                        and axis not in sharded):
+                    yield Finding(
+                        "collective-mismatch", mod.path, anode.lineno,
+                        anode.col_offset,
+                        f"{short} over axis '{axis}' but the enclosing "
+                        f"shard_map's in_specs never shard '{axis}' — "
+                        f"the reduction multiplies replicated values "
+                        f"by the axis size")
+
+
+# ---------------------------------------------------------------------
+# implicit-reshard
+# ---------------------------------------------------------------------
+
+def _norm_sig(sig):
+    """Trailing ``None`` entries are semantically absent: jax treats
+    ``P()`` and ``P(None, None)`` as the same fully-replicated spec."""
+    entries = list(sig)
+    while entries and entries[-1] is None:
+        entries.pop()
+    return tuple(entries)
+
+
+@shard_rule("implicit-reshard",
+            "an argument's inferred sharding disagrees with the "
+            "in_shardings of the jit it feeds")
+def check_implicit_reshard(pkg: Package, mod: ModuleInfo):
+    """When a jit declares ``in_shardings`` and the argument arrives
+    laid out differently, XLA inserts a silent device-to-device copy
+    before the program runs.  On a donated argument that copy also
+    defeats the donation — the "freed" buffer lives on through the
+    call, and peak HBM doubles exactly where ``donate_argnums`` was
+    supposed to halve it.  Fires only when BOTH sides resolve to
+    literal ``PartitionSpec``s (through ``NamedSharding``/
+    ``device_put``/``with_sharding_constraint`` bindings and builder
+    return summaries); symbolic or unknown shardings stay quiet.
+    """
+    an = analyze(pkg)
+    for scope, call in _module_calls(mod):
+        if scope is None:
+            continue
+        jit = an.lookup(scope, call.func.id) \
+            if isinstance(call.func, ast.Name) else None
+        if not isinstance(jit, ShardJit):
+            continue
+        for pos, arg in enumerate(call.args):
+            expected = jit.expected(pos)
+            if expected is None or not expected.exact:
+                continue
+            actual = an.resolve_spec(mod, scope, arg)
+            if actual is None or not actual.exact:
+                continue
+            if _norm_sig(actual.sig) == _norm_sig(expected.sig):
+                continue
+            donated = pos in jit.donate
+            tail = (" — and position %d is donated, so the silent "
+                    "copy defeats the donation" % pos if donated
+                    else "")
+            yield Finding(
+                "implicit-reshard", mod.path, call.lineno,
+                call.col_offset,
+                f"argument {pos} is laid out as "
+                f"PartitionSpec{tuple(actual.sig)!r} but the jit's "
+                f"in_shardings expect "
+                f"PartitionSpec{tuple(expected.sig)!r} — XLA will "
+                f"insert a silent resharding copy{tail}")
+
+
+# ---------------------------------------------------------------------
+# divergent-control
+# ---------------------------------------------------------------------
+
+def _exits_block(body) -> bool:
+    return any(isinstance(s, (ast.Return, ast.Raise, ast.Break,
+                              ast.Continue)) for s in body)
+
+
+@shard_rule("divergent-control",
+            "host-divergent values (jax.process_index) decide whether "
+            "or in what order a collective runs")
+def check_divergent_control(pkg: Package, mod: ModuleInfo):
+    """Every process of a multihost job must issue the same collectives
+    in the same order; a collective guarded by a value derived from
+    ``jax.process_index()`` (directly, through a function that returns
+    one, or through a ``self.primary``-style attribute) deadlocks the
+    pod — process 0 takes the branch, its peers wait forever.  Flags a
+    collective (or a call into a function that transitively performs
+    one) inside an ``if``/``while`` body whose test is host-divergent,
+    inside a ``for`` over a host-divergent iterable, and after a
+    divergent guard that ends in ``return``/``raise``/``break``/
+    ``continue``.  The safe idiom stays quiet: computing a divergent
+    VALUE and broadcasting it (``sync_epoch_code``) runs the collective
+    unconditionally — and a collective's result is synchronized, so
+    branching on it afterwards is fine.
+    """
+    an = analyze(pkg)
+    for fn in mod.functions:
+        ev = an.divergence_eval(fn)
+        findings = []
+
+        def scan(node, why):
+            # manual stack so nested def/lambda bodies are PRUNED (a
+            # collective there runs at its call site, not here)
+            stack = [node]
+            while stack:
+                cur = stack.pop()
+                if isinstance(cur, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef, ast.Lambda,
+                                    ast.ClassDef)):
+                    continue
+                if isinstance(cur, ast.Call):
+                    what = an.is_collective_call(mod, fn, cur)
+                    if what is not None:
+                        findings.append(Finding(
+                            "divergent-control", mod.path, cur.lineno,
+                            cur.col_offset,
+                            f"collective {what} runs {why} a value "
+                            f"derived from jax.process_index() — "
+                            f"processes that branch differently "
+                            f"deadlock in the collective"))
+                stack.extend(ast.iter_child_nodes(cur))
+
+        def walk_block(stmts):
+            guarded = False
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue
+                if guarded:
+                    scan(stmt, "after an early exit guarded by")
+                    continue
+                if isinstance(stmt, (ast.If, ast.While)) \
+                        and ev.taint(stmt.test):
+                    for sub in stmt.body + stmt.orelse:
+                        scan(sub, "under a branch on")
+                    # exactly ONE branch exiting means the code after
+                    # this statement runs on a process-dependent subset
+                    # (`if not primary: return` and the equivalent
+                    # `if primary: pass / else: return` both count)
+                    if isinstance(stmt, ast.If) \
+                            and (_exits_block(stmt.body)
+                                 != _exits_block(stmt.orelse)):
+                        guarded = True
+                    continue
+                if isinstance(stmt, (ast.For, ast.AsyncFor)) \
+                        and ev.taint(stmt.iter):
+                    for sub in stmt.body + stmt.orelse:
+                        scan(sub, "in an iteration order driven by")
+                    continue
+                for field in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, field, None)
+                    if sub:
+                        walk_block(sub)
+                if isinstance(stmt, ast.Try):
+                    for handler in stmt.handlers:
+                        walk_block(handler.body)
+
+        body = fn.node.body if not isinstance(fn.node, ast.Lambda) else []
+        walk_block(body)
+        yield from findings
+
+
+# ---------------------------------------------------------------------
+# unsynced-divisibility
+# ---------------------------------------------------------------------
+
+def _has_divisibility_guard(fn) -> bool:
+    """A modulo expression used as a CHECK — inside a comparison, an
+    assert, or directly as an ``if``/``while`` truthiness test
+    (``if dim % n: raise``) — anywhere in the function: the static
+    evidence that the split dimension was verified divisible before
+    sharding."""
+    for node in own_nodes(fn):
+        probes = []
+        if isinstance(node, ast.Compare):
+            probes = [node.left] + list(node.comparators)
+        elif isinstance(node, ast.Assert):
+            probes = [node.test]
+        elif isinstance(node, (ast.If, ast.While)):
+            probes = [node.test]
+        for probe in probes:
+            for sub in ast.walk(probe):
+                if isinstance(sub, ast.BinOp) \
+                        and isinstance(sub.op, ast.Mod):
+                    return True
+    return False
+
+
+@shard_rule("unsynced-divisibility",
+            "a dim is constrained onto dp/sp with no static "
+            "divisibility guard in the function")
+def check_unsynced_divisibility(pkg: Package, mod: ModuleInfo):
+    """``with_sharding_constraint(x, P('dp', ...))`` requires the
+    constrained dims to divide by the axis sizes — a property that
+    holds on the 1-chip CI mesh for EVERY size and breaks only at pod
+    axis sizes.  The repo's contract is that any function applying such
+    a constraint carries a static divisibility check (a ``%``
+    comparison or assert, like ``leaf.shape[1] % sp_size == 0`` in
+    ``parallel/update.py``) so the guarantee is visible where the
+    sharding happens.  Constraints whose spec cannot be resolved to
+    literal axes stay quiet.
+    """
+    an = analyze(pkg)
+    guard_cache: Dict[object, bool] = {}
+
+    def guarded(fn) -> bool:
+        # the guard may live in the enclosing builder (closure chain)
+        probe = fn
+        while probe is not None:
+            if probe not in guard_cache:
+                guard_cache[probe] = _has_divisibility_guard(probe)
+            if guard_cache[probe]:
+                return True
+            probe = probe.parent
+        return False
+
+    for scope, call in _module_calls(mod):
+        if scope is None:
+            continue
+        name = pkg.full_name(mod, scope, call.func)
+        if name not in CONSTRAINT_NAMES or len(call.args) < 2:
+            continue
+        fact = an.resolve_spec(mod, scope, call.args[1])
+        if fact is None or not fact.axes:
+            continue
+        if guarded(scope):
+            continue
+        axes = sorted(fact.axes)
+        yield Finding(
+            "unsynced-divisibility", mod.path, call.lineno,
+            call.col_offset,
+            f"with_sharding_constraint splits dims over {axes} but "
+            f"this function has no static divisibility guard — add "
+            f"an explicit `dim % axis_size == 0` check (or assert) "
+            f"where the constraint is applied")

@@ -132,6 +132,14 @@ class TrainConfig:
     # (counts jax.device_get / np.asarray / np.array on device values;
     # a growing count means a host sync crept into the hot loop)
     host_transfer_guard: bool = True
+    # arm a ShardingContractGuard around the jitted update step and
+    # report per-epoch resharding-copy counts (`resharding_copies`) in
+    # the metrics jsonl: an argument whose sharding deviates from its
+    # first call costs a silent XLA copy per step and defeats donation
+    sharding_contract_guard: bool = True
+    # resharding-copy budget asserted by the guard at the offending
+    # call; 0 = count and report, but never raise
+    max_resharding_copies: int = 0
     # league-lite: schedule PAST-SELF opponents into generation jobs.
     # {past_epochs: K} samples one opponent seat per league job from
     # the retained checkpoints of the last K epochs; optional prob
@@ -163,7 +171,7 @@ class TrainConfig:
         for key in ("columnar_cache_mb", "checkpoint_keep_last",
                     "checkpoint_keep_every", "device_replay_mb",
                     "device_replay_episodes", "updates_per_epoch",
-                    "max_update_compiles"):
+                    "max_update_compiles", "max_resharding_copies"):
             if getattr(self, key) < 0:
                 raise ValueError(f"{key} must be >= 0")
         if self.device_replay not in ("auto", "on", "off"):

@@ -42,7 +42,11 @@ try:
 except ImportError:  # pragma: no cover
     psutil = None
 
-from .analysis.guards import HostTransferGuard, RetraceGuard
+from .analysis.guards import (
+    HostTransferGuard,
+    RetraceGuard,
+    ShardingContractGuard,
+)
 from .batch import make_batch
 from .connection import MultiProcessJobExecutor
 from .environment import make_env, prepare_env
@@ -411,6 +415,15 @@ class Trainer:
         self.transfer_guard = (
             HostTransferGuard()
             if self.args.get("host_transfer_guard", True) else None)
+        # sharding contract: the update step's arguments must keep the
+        # layout of their first call — any later deviation is a silent
+        # XLA resharding copy per step (and defeats donation), reported
+        # per epoch as `resharding_copies` next to the retrace count
+        self.shard_guard = (
+            ShardingContractGuard(
+                max_copies=self.args.get("max_resharding_copies", 0),
+                name="update_step")
+            if self.args.get("sharding_contract_guard", True) else None)
 
         if self.num_params > 0:
             self.optimizer = make_optimizer(
@@ -418,7 +431,7 @@ class Trainer:
             self.params = model.params
             self.opt_state = self.optimizer.init(self.params)
             self.update_step = self.retrace_guard.wrap(
-                self._build_update_step())
+                self._wrap_sharding(self._build_update_step()))
             self._maybe_restore_train_state()
             if self.multihost:
                 self._sync_initial_state()
@@ -435,19 +448,24 @@ class Trainer:
             # instead assembles global batches from the local rings
             # and runs the global update_step)
             self._replay_step = self.retrace_guard.wrap(
-                make_replay_update_step(
+                self._wrap_sharding(make_replay_update_step(
                     self.device_replay, self.model, self.loss_cfg,
                     self.optimizer, self.compute_dtype,
                     batch_size=self.args["batch_size"],
                     mesh=self.train_mesh, params=self.params,
                     fsdp=self.train_fsdp,
-                    seed=self.args.get("seed", 0)))
+                    seed=self.args.get("seed", 0))))
         # the host batcher farm exists only when the device-resident
         # path is off: skipping it frees host cores for actors
         self.batcher = None
         if self.optimizer is not None and self.device_replay is None:
             self.batcher = Batcher(self.args, self.episodes,
                                    batch_size=self.local_batch_size)
+
+    def _wrap_sharding(self, step):
+        if self.shard_guard is None:
+            return step
+        return self.shard_guard.wrap(step)
 
     def _maybe_device_replay(self):
         """Build the HBM-resident replay (staging.DeviceReplay) when
@@ -902,6 +920,12 @@ class Trainer:
         if self.transfer_guard is not None:
             self.last_metrics["host_transfers"] = \
                 self.transfer_guard.snapshot()
+        if self.shard_guard is not None:
+            # per-epoch resharding copies at the update-step boundary;
+            # steady state is 0 (donated state keeps its layout, the
+            # feed stages batches onto the batch sharding)
+            self.last_metrics["resharding_copies"] = \
+                self.shard_guard.snapshot()
         if self.device_replay is not None:
             self.last_metrics["replay_episodes"] = \
                 self.device_replay.episodes_seen

@@ -148,7 +148,8 @@ def plot(epochs, out_prefix):
     # retrace_count is cumulative and must stay FLAT after epoch 1;
     # host_transfers is the per-epoch delta and must not grow with the
     # step count — a rising line on either is a hot-path regression
-    guard_keys = [k for k in ("retrace_count", "host_transfers")
+    guard_keys = [k for k in ("retrace_count", "host_transfers",
+                              "resharding_copies")
                   if any(k in e for e in epochs)]
     if guard_keys:
         fig, ax = plt.subplots(figsize=(8, 5))

@@ -1,0 +1,17 @@
+"""Fixture: each axis shards at most one dim."""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def make_mesh():
+    return Mesh(np.asarray(jax.devices()).reshape(-1, 1), ("dp", "tp"))
+
+
+def batch_spec():
+    return P("dp", "tp")
+
+
+def grouped_spec():
+    return P(("dp", "tp"), None)

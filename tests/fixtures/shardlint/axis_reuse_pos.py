@@ -1,0 +1,17 @@
+"""Fixture: the same mesh axis splitting two dims of one spec."""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def make_mesh():
+    return Mesh(np.asarray(jax.devices()).reshape(-1, 1), ("dp", "tp"))
+
+
+def bad_spec():
+    return P("dp", "dp")
+
+
+def bad_grouped_spec():
+    return P(("dp", "tp"), "dp")

@@ -1,0 +1,14 @@
+"""Fixture: suppressed axis-reuse."""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def make_mesh():
+    return Mesh(np.asarray(jax.devices()).reshape(-1, 1), ("dp", "tp"))
+
+
+def weird_spec():
+    # jaxlint: disable=axis-reuse -- documenting the invalid form in a repr test
+    return P("dp", "dp")

@@ -1,0 +1,32 @@
+"""Fixture: collectives over exactly the axes their shard_map shards
+(including through an interprocedural hop)."""
+
+import jax
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+AXES = ("dp", "tp")
+
+
+def make_mesh():
+    return Mesh(np.asarray(jax.devices()).reshape(-1, 1), AXES)
+
+
+def grad_mean(g):
+    return jax.lax.pmean(g, "dp")
+
+
+def step_body(g):
+    return grad_mean(g)  # reached from the shard_map entry below
+
+
+def make_step(mesh):
+    return shard_map(step_body, mesh=mesh, in_specs=(P("dp", "tp"),),
+                     out_specs=P("dp", "tp"))
+
+
+def make_opaque_step(mesh, specs):
+    # in_specs unresolvable: the body's collectives are not judged
+    return shard_map(grad_mean, mesh=mesh, in_specs=specs,
+                     out_specs=specs)

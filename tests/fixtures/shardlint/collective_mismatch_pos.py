@@ -1,0 +1,29 @@
+"""Fixture: a reduction over an axis the enclosing shard_map never
+shards, and a collective with no axis-binding transform at all."""
+
+import jax
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+AXES = ("dp", "tp")
+
+
+def make_mesh():
+    return Mesh(np.asarray(jax.devices()).reshape(-1, 1), AXES)
+
+
+def grad_sum(g):
+    # the shard_map below only shards dp: psum over tp multiplies
+    # replicated values by the tp axis size
+    return jax.lax.psum(g, "tp")
+
+
+def make_step(mesh):
+    return shard_map(grad_sum, mesh=mesh, in_specs=P("dp"),
+                     out_specs=P("dp"))
+
+
+def stray_mean(x):
+    # nothing binds dp here: unbound axis name at trace time
+    return jax.lax.pmean(x, "dp")

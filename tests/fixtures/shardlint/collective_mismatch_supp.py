@@ -1,0 +1,23 @@
+"""Fixture: suppressed collective-mismatch (intentional psum over a
+replicated axis, e.g. to materialize an axis-size factor)."""
+
+import jax
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+AXES = ("dp", "tp")
+
+
+def make_mesh():
+    return Mesh(np.asarray(jax.devices()).reshape(-1, 1), AXES)
+
+
+def grad_sum(g):
+    # jaxlint: disable=collective-mismatch -- deliberate: psum of a replicated value IS the tp size
+    return jax.lax.psum(g, "tp")
+
+
+def make_step(mesh):
+    return shard_map(grad_sum, mesh=mesh, in_specs=P("dp"),
+                     out_specs=P("dp"))

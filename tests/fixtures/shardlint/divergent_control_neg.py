@@ -1,0 +1,27 @@
+"""Fixture: the safe control-word idiom — a host-divergent VALUE flows
+into a collective every process runs unconditionally, and control flow
+branches only on the synchronized result."""
+
+import jax
+from jax.experimental import multihost_utils
+
+
+def sync_code(code):
+    out = multihost_utils.broadcast_one_to_all(code)
+    return int(out)
+
+
+def epoch_control(update_flag):
+    code = 0
+    if jax.process_index() == 0 and update_flag:
+        code = 1  # divergent value is fine: the collective still runs
+    code = sync_code(code)
+    if code == 1:  # branching on the synchronized result is fine
+        return "epoch-end"
+    return "step"
+
+
+def primary_only_io(record):
+    if jax.process_index() == 0:
+        print(record)  # host-side work under a divergent branch is fine
+    return record

@@ -1,0 +1,34 @@
+"""Fixture: collectives whose execution depends on jax.process_index()
+— the multihost deadlock shapes (branch, early exit, interprocedural
+divergence through a helper's return value)."""
+
+import jax
+from jax.experimental import multihost_utils
+
+
+def is_primary():
+    return jax.process_index() == 0
+
+
+def checkpoint_sync(state):
+    if is_primary():  # peers never enter the broadcast: deadlock
+        state = multihost_utils.broadcast_one_to_all(state)
+    return state
+
+
+def report_metrics(metrics):
+    if jax.process_index() != 0:
+        return None
+    # peers already returned: process 0 waits here forever
+    return multihost_utils.broadcast_one_to_all(metrics)
+
+
+def orelse_exit(state):
+    primary = jax.process_index() == 0
+    if primary:
+        pass
+    else:
+        return state
+    # equivalent early-exit shape, exit in the ELSE branch: only
+    # process 0 reaches the broadcast
+    return multihost_utils.broadcast_one_to_all(state)

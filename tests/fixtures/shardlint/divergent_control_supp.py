@@ -1,0 +1,12 @@
+"""Fixture: suppressed divergent-control (a rescue path that is
+documented to run on every process despite the guard's look)."""
+
+import jax
+from jax.experimental import multihost_utils
+
+
+def rescue(state, peers_know_to_enter):
+    if jax.process_index() == 0 and peers_know_to_enter:
+        # jaxlint: disable=divergent-control -- peers mirror this branch via the out-of-band flag above
+        state = multihost_utils.broadcast_one_to_all(state)
+    return state

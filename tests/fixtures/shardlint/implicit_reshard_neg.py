@@ -1,0 +1,30 @@
+"""Fixture: call-site layouts agree with the jit's in_shardings (or
+are unknown, which stays quiet)."""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh():
+    return Mesh(np.asarray(jax.devices()).reshape(-1, 1), ("dp", "tp"))
+
+
+def train_step(mesh, params, batch):
+    rep = NamedSharding(mesh, P())
+    dp = NamedSharding(mesh, P("dp"))
+    step = jax.jit(lambda p, b: (p, b.sum()), in_shardings=(rep, dp),
+                   donate_argnums=(0,))
+    params = jax.device_put(params, rep)  # matches in_shardings[0]
+    return step(params, batch)            # batch layout unknown: quiet
+
+
+def trailing_none_equivalence(mesh, params, batch):
+    # P() and P(None, None) are the same fully-replicated spec: jax
+    # normalizes trailing Nones, so no copy happens and none is flagged
+    rep2 = NamedSharding(mesh, P(None, None))
+    plain = NamedSharding(mesh, P())
+    step = jax.jit(lambda p, b: (p, b.sum()), in_shardings=(rep2, None),
+                   donate_argnums=(0,))
+    params = jax.device_put(params, plain)
+    return step(params, batch)

@@ -1,0 +1,20 @@
+"""Fixture: feeding a jit an array laid out differently from its
+declared in_shardings — XLA inserts a silent copy, and the donated
+position's donation is defeated."""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh():
+    return Mesh(np.asarray(jax.devices()).reshape(-1, 1), ("dp", "tp"))
+
+
+def train_step(mesh, params, batch):
+    rep = NamedSharding(mesh, P())
+    dp = NamedSharding(mesh, P("dp"))
+    step = jax.jit(lambda p, b: (p, b.sum()), in_shardings=(rep, dp),
+                   donate_argnums=(0,))
+    params = jax.device_put(params, dp)  # but the jit expects P()
+    return step(params, batch)

@@ -1,0 +1,20 @@
+"""Fixture: suppressed implicit-reshard (a one-time re-layout at
+startup, not on the hot path)."""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh():
+    return Mesh(np.asarray(jax.devices()).reshape(-1, 1), ("dp", "tp"))
+
+
+def restore_step(mesh, params, batch):
+    rep = NamedSharding(mesh, P())
+    dp = NamedSharding(mesh, P("dp"))
+    step = jax.jit(lambda p, b: (p, b.sum()), in_shardings=(rep, dp),
+                   donate_argnums=(0,))
+    params = jax.device_put(params, dp)
+    # jaxlint: disable=implicit-reshard -- one-time checkpoint restore; the copy is off the hot path
+    return step(params, batch)

@@ -1,0 +1,30 @@
+"""Fixture: every spec entry and collective axis names a declared mesh
+axis (including through the module-level AXES constant)."""
+
+import jax
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "tp")
+
+
+def make_mesh():
+    return Mesh(np.asarray(jax.devices()).reshape(-1, 1), AXES)
+
+
+def batch_sharding(mesh):
+    return NamedSharding(mesh, P("dp"))
+
+
+def param_sharding(mesh, rank):
+    return NamedSharding(mesh, P(*([None] * (rank - 1) + ["tp"])))
+
+
+def grad_mean(g):
+    return jax.lax.pmean(g, "dp")
+
+
+def make_step(mesh):
+    return shard_map(grad_mean, mesh=mesh, in_specs=P("dp"),
+                     out_specs=P("dp"))

@@ -1,0 +1,22 @@
+"""Fixture: PartitionSpec entries / collective axis names that the
+constructed mesh never declares."""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "tp")
+
+
+def make_mesh():
+    return Mesh(np.asarray(jax.devices()).reshape(-1, 1), AXES)
+
+
+def batch_sharding(mesh):
+    # "data" is not an axis of the mesh built above
+    return NamedSharding(mesh, P("data"))
+
+
+def loss_mean(x):
+    # "model" is not a mesh axis either
+    return jax.lax.pmean(x, "model")

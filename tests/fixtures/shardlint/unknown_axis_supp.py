@@ -1,0 +1,17 @@
+"""Fixture: suppressed unknown-axis (spec belongs to an external mesh
+the analyzer cannot see)."""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "tp")
+
+
+def make_mesh():
+    return Mesh(np.asarray(jax.devices()).reshape(-1, 1), AXES)
+
+
+def batch_sharding(mesh):
+    # jaxlint: disable=unknown-axis -- spec targets the caller's externally built mesh
+    return NamedSharding(mesh, P("data"))

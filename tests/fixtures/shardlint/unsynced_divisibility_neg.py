@@ -1,0 +1,30 @@
+"""Fixture: the constraint rides behind an explicit divisibility
+check (and symbolic specs stay quiet)."""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh():
+    return Mesh(np.asarray(jax.devices()).reshape(-1, 1), ("dp", "sp"))
+
+
+def shard_batch(mesh, batch, sp_size):
+    sharded = NamedSharding(mesh, P("dp", "sp"))
+    if batch.shape[0] % sp_size == 0:
+        return jax.lax.with_sharding_constraint(batch, sharded)
+    return batch
+
+
+def shard_opaque(batch, sharding):
+    # the spec is the caller's problem: unresolvable, stays quiet
+    return jax.lax.with_sharding_constraint(batch, sharding)
+
+
+def shard_batch_truthiness_guard(mesh, batch, dp_size):
+    # the `if dim % n: raise` spelling counts as a guard too
+    sharded = NamedSharding(mesh, P("dp"))
+    if batch.shape[0] % dp_size:
+        raise ValueError("batch must divide dp")
+    return jax.lax.with_sharding_constraint(batch, sharded)

@@ -1,0 +1,16 @@
+"""Fixture: a dim constrained onto dp/sp with no static divisibility
+guard anywhere in the function."""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh():
+    return Mesh(np.asarray(jax.devices()).reshape(-1, 1), ("dp", "sp"))
+
+
+def shard_batch(mesh, batch):
+    sharded = NamedSharding(mesh, P("dp", "sp"))
+    # nothing proves batch.shape divides by the dp/sp axis sizes
+    return jax.lax.with_sharding_constraint(batch, sharded)

@@ -1,0 +1,16 @@
+"""Fixture: suppressed unsynced-divisibility (divisibility enforced by
+the config validator at startup, not at the constraint site)."""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh():
+    return Mesh(np.asarray(jax.devices()).reshape(-1, 1), ("dp", "sp"))
+
+
+def shard_batch(mesh, batch):
+    sharded = NamedSharding(mesh, P("dp", "sp"))
+    # jaxlint: disable=unsynced-divisibility -- batch geometry validated against the mesh in config load
+    return jax.lax.with_sharding_constraint(batch, sharded)

@@ -52,13 +52,15 @@ def test_docs_exist():
 
 
 def test_static_analysis_doc_covers_every_rule():
-    """docs/static_analysis.md documents each lint rule by id (the
-    suppression comments reference these names, so the page is the
-    rule registry's public contract)."""
+    """docs/static_analysis.md documents each lint rule by id — BOTH
+    registries (the suppression comments reference these names, so the
+    page is the rule registries' public contract)."""
     from handyrl_tpu.analysis.rules import RULES
+    from handyrl_tpu.analysis.shardrules import SHARD_RULES
 
     path = os.path.join(os.path.dirname(DOCS), "static_analysis.md")
     with open(path) as f:
         text = f.read()
-    missing = [r for r in RULES if f"`{r}`" not in text]
+    missing = [r for r in list(RULES) + list(SHARD_RULES)
+               if f"`{r}`" not in text]
     assert not missing, f"rules undocumented in static_analysis.md: {missing}"

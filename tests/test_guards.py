@@ -1,6 +1,8 @@
 """Runtime guard suite: RetraceGuard compile accounting (cache-size and
-signature-fallback paths, budget enforcement) and HostTransferGuard
-transfer counting (device hits, host passes, budget, restoration)."""
+signature-fallback paths, budget enforcement), HostTransferGuard
+transfer counting (device hits, host passes, budget, restoration), and
+ShardingContractGuard resharding accounting (contract capture, copy
+counting, budget, snapshot deltas)."""
 
 import jax
 import jax.numpy as jnp
@@ -12,6 +14,8 @@ from handyrl_tpu.analysis.guards import (
     HostTransferGuard,
     RetraceError,
     RetraceGuard,
+    ShardingContractError,
+    ShardingContractGuard,
 )
 
 
@@ -110,6 +114,7 @@ def test_host_transfer_guard_cheap_on_big_host_lists():
 
 
 def test_host_transfer_guard_counts_device_syncs():
+    # jaxlint: disable=retrace-risk -- one-shot helper to mint a committed device array
     value = jax.jit(lambda x: x + 1)(jnp.ones(3))
     with HostTransferGuard() as guard:
         np.asarray(value)
@@ -167,3 +172,122 @@ def test_host_transfer_guard_not_reentrant():
     with HostTransferGuard() as guard:
         with pytest.raises(RuntimeError, match="reentrant"):
             guard.__enter__()
+
+
+# -- ShardingContractGuard --------------------------------------------
+
+def test_sharding_guard_stable_layout_counts_nothing():
+    guard = ShardingContractGuard(name="step")
+    step = guard.wrap(jax.jit(lambda x: x * 2))
+    for _ in range(5):
+        step(jnp.ones(4))
+    assert guard.copies == 0
+    assert guard.snapshot() == 0
+
+
+def test_sharding_guard_counts_device_layout_change():
+    # two CPU devices from the virtual 8-device mesh: placing the same
+    # argument on a different device changes its SingleDeviceSharding,
+    # which is exactly a resharding copy at the jit boundary
+    devices = jax.devices()
+    if len(devices) < 2:
+        pytest.skip("needs 2 virtual devices")
+    guard = ShardingContractGuard(name="step")
+    step = guard.wrap(jax.jit(lambda x: x + 1))
+    step(jax.device_put(jnp.ones(4), devices[0]))
+    step(jax.device_put(jnp.ones(4), devices[1]))
+    assert guard.copies == 1
+    assert guard.snapshot() == 1
+    assert guard.snapshot() == 0
+
+
+def test_sharding_guard_counts_named_sharding_change():
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        pytest.skip("needs 2 virtual devices")
+    mesh = Mesh(np.asarray(devices[:2]), ("dp",))
+    rep = NamedSharding(mesh, P())
+    dp = NamedSharding(mesh, P("dp"))
+    guard = ShardingContractGuard(name="step")
+    step = guard.wrap(jax.jit(lambda x: x.sum()))
+    step(jax.device_put(jnp.ones(4), rep))
+    step(jax.device_put(jnp.ones(4), dp))  # silent reshard
+    assert guard.copies == 1
+
+
+def test_sharding_guard_budget_raises_at_the_offending_call():
+    devices = jax.devices()
+    if len(devices) < 2:
+        pytest.skip("needs 2 virtual devices")
+    guard = ShardingContractGuard(max_copies=0, name="step")
+    assert guard.max_copies == 0  # 0 = count only, never raise
+    strict = ShardingContractGuard(max_copies=1, name="update_step")
+    step = strict.wrap(jax.jit(lambda x: x + 1))
+    step(jax.device_put(jnp.ones(4), devices[0]))
+    step(jax.device_put(jnp.ones(4), devices[1]))  # 1 copy: at budget
+    with pytest.raises(ShardingContractError, match="update_step"):
+        step(jax.device_put(jnp.ones(4), devices[1]))  # over budget
+
+
+def test_sharding_guard_new_treedef_opens_fresh_contract():
+    # a different argument STRUCTURE is a different program (its own
+    # compile, its own contract) — not a resharding of the old one
+    devices = jax.devices()
+    if len(devices) < 2:
+        pytest.skip("needs 2 virtual devices")
+    guard = ShardingContractGuard(name="step")
+    step = guard.wrap(
+        jax.jit(lambda t: jax.tree.map(lambda a: a + 1, t)))
+    step({"a": jax.device_put(jnp.ones(4), devices[1])})
+    step({"a": jax.device_put(jnp.ones(4), devices[1]),
+          "b": jax.device_put(jnp.ones(4), devices[1])})
+    assert guard.copies == 0
+
+
+def test_sharding_guard_skips_hostside_leaves():
+    # numpy arrays / python scalars have no .sharding: the jit's own
+    # device_put places them per its contract, nothing to compare
+    guard = ShardingContractGuard(name="step")
+    step = guard.wrap(jax.jit(lambda x, lr: x * lr))
+    step(np.ones(4), 0.5)
+    step(np.ones(4), 0.25)
+    assert guard.copies == 0
+
+
+def test_sharding_guard_uncommitted_first_call_is_free():
+    """The learner's first step feeds freshly optimizer.init-ed state:
+    uncommitted arrays whose placement onto the mesh is designed
+    initialization.  The contract must latch on the committed layout
+    the donated outputs come back with — NOT on the uncommitted first
+    call — or every subsequent step would count as a reshard (the
+    exact e2e failure this guard's first design had)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devices = jax.devices()
+    if len(devices) < 4:
+        pytest.skip("needs 4 virtual devices")
+    mesh = Mesh(np.asarray(devices[:4]), ("dp",))
+    rep = NamedSharding(mesh, P())
+    guard = ShardingContractGuard(max_copies=1, name="update_step")
+    step = guard.wrap(jax.jit(
+        lambda s: s + 1, in_shardings=(rep,), out_shardings=rep,
+        donate_argnums=(0,)))
+    state = jnp.zeros(4, jnp.int32)       # uncommitted: free to place
+    for _ in range(5):
+        state = step(state)               # committed rep after call 1
+    assert guard.copies == 0
+
+
+def test_sharding_guard_sums_over_wrapped_fns():
+    devices = jax.devices()
+    if len(devices) < 2:
+        pytest.skip("needs 2 virtual devices")
+    guard = ShardingContractGuard(name="pair")
+    a = guard.wrap(jax.jit(lambda x: x + 1))
+    b = guard.wrap(jax.jit(lambda x: x - 1))
+    a(jax.device_put(jnp.ones(2), devices[0]))
+    b(jax.device_put(jnp.ones(2), devices[0]))
+    a(jax.device_put(jnp.ones(2), devices[1]))
+    assert guard.copies == 1

@@ -37,13 +37,53 @@ def test_param_sharding_tp_rule():
         "head": {"kernel": np.zeros((32, 9))},
     }
     shardings = param_sharding(mesh, params)
-    # wide kernels shard output features over tp
+    # wide kernels shard output features over tp.  (The expected-spec
+    # literals name the tp axis make_mesh declares inside the package;
+    # a tests-only lint scan cannot see that declaration.)
+    # jaxlint: disable=unknown-axis -- expected-value literal; tp is declared by parallel.mesh.AXES
     assert shardings["dense"]["kernel"].spec == jax.sharding.PartitionSpec(None, "tp")
-    assert shardings["conv"]["kernel"].spec == jax.sharding.PartitionSpec(
-        None, None, None, "tp")
+    conv_spec = shardings["conv"]["kernel"].spec
+    # jaxlint: disable=unknown-axis -- expected-value literal; tp is declared by parallel.mesh.AXES
+    assert conv_spec == jax.sharding.PartitionSpec(None, None, None, "tp")
     # biases and narrow heads replicate
     assert shardings["dense"]["bias"].spec == jax.sharding.PartitionSpec()
     assert shardings["head"]["kernel"].spec == jax.sharding.PartitionSpec()
+
+
+def test_param_sharding_tp_boundaries():
+    """The tp rule's edges: dim == min_tp_dim (128) is the smallest
+    dim that shards; non-divisible dims and rank-1 params fall back to
+    replication WITHOUT raising — an odd head size must degrade, not
+    crash the learner at mesh build."""
+    _need_devices(8)
+    P = jax.sharding.PartitionSpec
+    mesh = make_mesh(MeshSpec(dp=4, tp=2), devices=jax.devices()[:8])
+    params = {
+        "at_floor": np.zeros((64, 128)),     # == min_tp_dim: shards
+        "below_floor": np.zeros((64, 126)),  # divisible but < 128
+        "indivisible": np.zeros((64, 129)),  # 129 % 2 != 0
+        "rank1": np.zeros((256,)),           # bias-like: replicates
+        "scalar": np.zeros(()),              # rank-0: replicates
+    }
+    shardings = param_sharding(mesh, params)
+    assert shardings["at_floor"].spec == P(None, "tp")
+    assert shardings["below_floor"].spec == P()
+    assert shardings["indivisible"].spec == P()
+    assert shardings["rank1"].spec == P()
+    assert shardings["scalar"].spec == P()
+    # the shardings are actually placeable (no deferred errors)
+    placed = jax.device_put(params, shardings)
+    assert jax.tree.structure(placed) == jax.tree.structure(params)
+
+
+def test_param_sharding_min_tp_dim_is_tunable():
+    _need_devices(8)
+    P = jax.sharding.PartitionSpec
+    mesh = make_mesh(MeshSpec(dp=4, tp=2), devices=jax.devices()[:8])
+    params = {"small": np.zeros((8, 32))}
+    assert param_sharding(mesh, params)["small"].spec == P()
+    lowered = param_sharding(mesh, params, min_tp_dim=32)
+    assert lowered["small"].spec == P(None, "tp")
 
 
 @pytest.mark.slow

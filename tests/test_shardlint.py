@@ -1,0 +1,254 @@
+"""shardlint rule suite: every sharding rule fires on its positive
+fixture, stays quiet on its negative, and obeys suppression comments —
+plus the unified-CLI surface (--shard/--sarif/--exclude) and the repo
+gate (the whole package must shard-lint clean).
+
+Fixture convention (tests/fixtures/shardlint/): ``<rule>_pos.py`` must
+produce findings of exactly that rule, ``<rule>_neg.py`` and
+``<rule>_supp.py`` must produce none — under the FULL combined rule
+set (jaxlint + shardlint), so the fixtures also prove the two rule
+families do not bleed into each other.  The fixtures are parsed,
+never imported."""
+
+import json
+import os
+
+import pytest
+
+from handyrl_tpu.analysis.jaxlint import (
+    active_registry,
+    lint_paths,
+    lint_source,
+    main,
+)
+from handyrl_tpu.analysis.rules import RULES
+from handyrl_tpu.analysis.shardrules import SHARD_RULES
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures",
+                        "shardlint")
+REPO_PACKAGE = os.path.join(
+    os.path.dirname(__file__), "..", "handyrl_tpu")
+
+RULE_IDS = sorted(SHARD_RULES)
+
+
+def fixture(rule_id, kind):
+    path = os.path.join(FIXTURES,
+                        f"{rule_id.replace('-', '_')}_{kind}.py")
+    assert os.path.exists(path), f"missing fixture {path}"
+    return path
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_rule_fires_on_positive_fixture(rule_id):
+    findings = lint_paths([fixture(rule_id, "pos")], shard=True)
+    assert findings, f"{rule_id} produced no findings on its positive"
+    assert all(f.rule == rule_id for f in findings), (
+        f"cross-rule noise on {rule_id}_pos: "
+        f"{[(f.rule, f.line) for f in findings]}")
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_rule_quiet_on_negative_fixture(rule_id):
+    findings = lint_paths([fixture(rule_id, "neg")], shard=True)
+    assert findings == [], (
+        f"false positives on {rule_id}_neg: "
+        f"{[(f.rule, f.line, f.message) for f in findings]}")
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_rule_suppressed_with_reason(rule_id):
+    findings = lint_paths([fixture(rule_id, "supp")], shard=True)
+    assert findings == [], (
+        f"suppression not honored on {rule_id}_supp: "
+        f"{[(f.rule, f.line) for f in findings]}")
+
+
+def test_shard_registry_is_exactly_the_issue_rule_set():
+    assert set(RULE_IDS) == {
+        "unknown-axis", "axis-reuse", "collective-mismatch",
+        "implicit-reshard", "divergent-control",
+        "unsynced-divisibility"}
+
+
+def test_registries_do_not_collide():
+    # one suppression namespace: a shard rule id must never shadow a
+    # base rule id (disable= comments name rules from either family)
+    assert not set(SHARD_RULES) & set(RULES)
+    combined = active_registry(shard=True)
+    assert set(combined) == set(RULES) | set(SHARD_RULES)
+
+
+def test_jaxlint_fixtures_stay_quiet_under_shard_rules():
+    """The base-rule fixtures must not trip the sharding rules: the
+    families stay independently testable."""
+    base_fixtures = os.path.join(os.path.dirname(__file__), "fixtures",
+                                 "jaxlint")
+    findings = lint_paths([base_fixtures], shard=True,
+                          select=sorted(SHARD_RULES))
+    assert findings == [], (
+        f"shard rules fired on jaxlint fixtures: "
+        f"{[(f.rule, f.path, f.line) for f in findings]}")
+
+
+def test_interprocedural_mesh_axes_cross_module():
+    """The unknown-axis rule sees axes declared by a Mesh built in a
+    DIFFERENT module of the same package (the repo shape: mesh.py
+    constructs, update.py/staging.py consume)."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        pkg = os.path.join(tmp, "pkg")
+        os.makedirs(pkg)
+        with open(os.path.join(pkg, "__init__.py"), "w") as f:
+            f.write("")
+        with open(os.path.join(pkg, "mesh.py"), "w") as f:
+            f.write(
+                "import jax\n"
+                "import numpy as np\n"
+                "from jax.sharding import Mesh\n\n"
+                "AXES = ('dp', 'tp')\n\n\n"
+                "def make_mesh():\n"
+                "    devs = np.asarray(jax.devices())\n"
+                "    return Mesh(devs.reshape(-1, 1), AXES)\n")
+        with open(os.path.join(pkg, "update.py"), "w") as f:
+            f.write(
+                "from jax.sharding import NamedSharding, "
+                "PartitionSpec as P\n\n\n"
+                "def batch(mesh):\n"
+                "    return NamedSharding(mesh, P('sp'))\n")
+        findings = lint_paths([pkg], shard=True)
+        assert [f.rule for f in findings] == ["unknown-axis"]
+        assert "'sp'" in findings[0].message
+
+
+def test_divergent_control_sees_attribute_facts():
+    """self.primary = jax.process_index() == 0 in __init__ makes a
+    later `if self.primary:` divergent — the learner's exact shape."""
+    src = (
+        "import jax\n"
+        "from jax.experimental import multihost_utils\n\n\n"
+        "class Trainer:\n"
+        "    def __init__(self):\n"
+        "        self.primary = jax.process_index() == 0\n\n"
+        "    def snapshot(self, state):\n"
+        "        if self.primary:\n"
+        "            state = multihost_utils.broadcast_one_to_all("
+        "state)\n"
+        "        return state\n")
+    findings = lint_source(src, shard=True)
+    assert [f.rule for f in findings] == ["divergent-control"]
+
+
+def test_safe_broadcast_idiom_stays_quiet():
+    """The learner's control-word pattern: divergent VALUE into an
+    unconditional collective, branch on the synchronized result."""
+    src = (
+        "import jax\n"
+        "from jax.experimental import multihost_utils\n\n\n"
+        "def epoch_control(flag):\n"
+        "    code = 0\n"
+        "    if jax.process_index() == 0 and flag:\n"
+        "        code = 1\n"
+        "    code = int(multihost_utils.broadcast_one_to_all(code))\n"
+        "    if code == 1:\n"
+        "        return 'end'\n"
+        "    return 'step'\n")
+    assert lint_source(src, shard=True) == []
+
+
+# -- CLI ---------------------------------------------------------------
+
+def test_cli_shard_flag_runs_shard_rules(capsys):
+    rc = main(["--shard", "--json", fixture("unknown-axis", "pos")])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert all(f["rule"] == "unknown-axis" for f in out["findings"])
+
+
+def test_cli_without_shard_flag_skips_shard_rules(capsys):
+    rc = main([fixture("unknown-axis", "pos")])
+    assert rc == 0
+    assert "clean" in capsys.readouterr().out
+
+
+def test_cli_shard_list_rules(capsys):
+    assert main(["--shard", "--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule_id in sorted(RULES) + RULE_IDS:
+        assert rule_id in out
+
+
+def test_cli_sarif_output(capsys):
+    rc = main(["--shard", "--sarif", fixture("axis-reuse", "pos")])
+    captured = capsys.readouterr()
+    doc = json.loads(captured.out)
+    assert rc == 1
+    # stdout is redirected to the artifact in CI: the human-readable
+    # findings must ALSO reach stderr so a red job log says why
+    assert "axis-reuse" in captured.err
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "handyrl-jaxlint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert set(RULES) | set(SHARD_RULES) <= rule_ids
+    assert run["results"], "no SARIF results for a positive fixture"
+    for result in run["results"]:
+        assert result["ruleId"] == "axis-reuse"
+        loc = result["locations"][0]["physicalLocation"]
+        assert loc["region"]["startLine"] > 0
+        assert loc["artifactLocation"]["uri"].endswith(
+            "axis_reuse_pos.py")
+
+
+def test_cli_sarif_clean_run_has_empty_results(capsys):
+    rc = main(["--sarif", fixture("axis-reuse", "neg")])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["runs"][0]["results"] == []
+
+
+def test_cli_json_and_sarif_are_mutually_exclusive(capsys):
+    assert main(["--json", "--sarif", FIXTURES]) == 2
+
+
+def test_cli_exclude_prunes_fixture_trees(capsys):
+    # linting the whole tests/ tree with fixtures excluded must not
+    # see the (intentionally broken) fixture files
+    tests_dir = os.path.dirname(__file__)
+    rc = main(["--shard", "--json",
+               "--exclude", os.path.join(tests_dir, "fixtures"),
+               FIXTURES])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["total"] == 0
+
+
+def test_cli_select_accepts_shard_rules_only_with_flag(capsys):
+    assert main(["--select", "unknown-axis", FIXTURES]) == 2
+    capsys.readouterr()
+    rc = main(["--shard", "--select", "unknown-axis",
+               fixture("unknown-axis", "pos")])
+    assert rc == 1
+
+
+# -- repo gate ---------------------------------------------------------
+
+def test_repo_shardlints_clean():
+    """The CI gate, enforced locally too: the shipped package must
+    have zero unsuppressed findings under the COMBINED rule set."""
+    findings = lint_paths([REPO_PACKAGE], shard=True)
+    assert findings == [], "\n".join(
+        f"{f.location}: [{f.rule}] {f.message}" for f in findings)
+
+
+def test_repo_mesh_axes_are_discovered():
+    """The analyzer must actually find the repo's mesh construction —
+    a refactor that hides it would silently disable unknown-axis."""
+    from handyrl_tpu.analysis.jaxlint import load_package
+    from handyrl_tpu.analysis.shardlint import analyze
+
+    package, _, _ = load_package([REPO_PACKAGE])
+    an = analyze(package)
+    assert an.mesh_axes is not None
+    assert {"dp", "sp", "tp"} <= set(an.mesh_axes)

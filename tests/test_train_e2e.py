@@ -43,11 +43,14 @@ def test_local_training_two_epochs(tmp_path, monkeypatch):
             "policy_target": "VTRACE",
             "value_target": "VTRACE",
             "seed": 1,
-            # retrace/host-sync guards armed for real: the update step
-            # may compile exactly once, and every epoch must report the
-            # guard counters into the metrics jsonl
+            # retrace/host-sync/sharding guards armed for real: the
+            # update step may compile exactly once, must never incur a
+            # resharding copy, and every epoch must report the guard
+            # counters into the metrics jsonl
             "max_update_compiles": 1,
             "host_transfer_guard": True,
+            "sharding_contract_guard": True,
+            "max_resharding_copies": 1,
             "metrics_path": "metrics.jsonl",
         },
         "worker_args": {"num_parallel": 2, "server_address": ""},
@@ -69,6 +72,14 @@ def test_local_training_two_epochs(tmp_path, monkeypatch):
     assert learner.trainer.retrace_guard.compiles == 1
     assert learner.trainer.retrace_guard.calls > 0
 
+    # sharding contract held for the whole run: every update-step
+    # argument kept the layout of its first committed call, so XLA
+    # inserted zero silent resharding copies.  (max_resharding_copies=1
+    # only raises at the SECOND copy — the == 0 assert here is what
+    # enforces zero; the armed budget proves the guard runs live.)
+    assert learner.trainer.shard_guard is not None
+    assert learner.trainer.shard_guard.copies == 0
+
     # guard counters flow into the metrics jsonl, one record per epoch
     with open("metrics.jsonl") as f:
         records = [json.loads(line) for line in f if line.strip()]
@@ -76,6 +87,7 @@ def test_local_training_two_epochs(tmp_path, monkeypatch):
     for record in records:
         assert record["retrace_count"] == 1
         assert record["host_transfers"] >= 1  # the epoch snapshot sync
+        assert record["resharding_copies"] == 0
 
     assert os.path.exists("models/1.ckpt")
     assert os.path.exists("models/2.ckpt")
