@@ -140,6 +140,30 @@ class TrainConfig:
     # resharding-copy budget asserted by the guard at the offending
     # call; 0 = count and report, but never raise
     max_resharding_copies: int = 0
+    # -- resilience (handyrl_tpu.resilience) --
+    # seconds of control-plane silence after which a gather sends an
+    # explicit heartbeat (liveness otherwise piggybacks on its normal
+    # traffic); 0 disables explicit beats
+    heartbeat_interval: float = 2.0
+    # seconds of total silence after which the learner counts a
+    # heartbeat miss and evicts the wedged gather (supervised local
+    # fleets respawn it)
+    heartbeat_timeout: float = 30.0
+    # circuit breaker: more than this many failures of one gather slot
+    # inside the supervisor's failure window marks the slot dead and
+    # shrinks the fleet instead of restart-storming (0 = strictest:
+    # dead on the first failure, no respawns)
+    max_respawns: int = 5
+    # base seconds for the jittered exponential respawn backoff
+    respawn_backoff: float = 0.5
+    # ceiling on one control-plane frame: a corrupt length header
+    # fails with FrameError instead of allocating gigabytes.  0 = the
+    # built-in 1 GiB default
+    max_frame_bytes: int = 0
+    # chaos fault injection for resilience tests (keys: kill_prob,
+    # kill_after, max_kills, frame_drop_prob, frame_truncate_prob,
+    # frame_delay_prob, frame_delay, seed); empty = off
+    chaos: Dict[str, Any] = field(default_factory=dict)
     # league-lite: schedule PAST-SELF opponents into generation jobs.
     # {past_epochs: K} samples one opponent seat per league job from
     # the retained checkpoints of the last K epochs; optional prob
@@ -171,9 +195,21 @@ class TrainConfig:
         for key in ("columnar_cache_mb", "checkpoint_keep_last",
                     "checkpoint_keep_every", "device_replay_mb",
                     "device_replay_episodes", "updates_per_epoch",
-                    "max_update_compiles", "max_resharding_copies"):
+                    "max_update_compiles", "max_resharding_copies",
+                    "heartbeat_interval", "max_respawns",
+                    "max_frame_bytes"):
             if getattr(self, key) < 0:
                 raise ValueError(f"{key} must be >= 0")
+        if self.respawn_backoff <= 0:
+            raise ValueError("respawn_backoff must be > 0")
+        if self.heartbeat_timeout <= self.heartbeat_interval:
+            raise ValueError(
+                "heartbeat_timeout must exceed heartbeat_interval")
+        # chaos keys/ranges validate in one place: the dataclass the
+        # injector actually runs with
+        from .resilience.chaos import ChaosConfig
+
+        ChaosConfig.from_config(self.chaos)
         if self.device_replay not in ("auto", "on", "off"):
             raise ValueError(
                 f"unknown device_replay {self.device_replay!r}")

@@ -24,6 +24,22 @@ from typing import Any, Callable, Dict, Iterable, Optional
 
 CHUNK = 1 << 14  # 16 KiB send granularity
 
+# Ceiling on a single control-plane frame.  Legitimate frames top out
+# at a pickled model snapshot (MBs); a corrupt or adversarial 4-byte
+# header could otherwise demand a ~4 GiB allocation before the first
+# payload byte arrives.  Configurable per connection via the
+# `max_frame_bytes` config key.
+DEFAULT_MAX_FRAME_BYTES = 1 << 30  # 1 GiB
+
+
+class FrameError(ConnectionError):
+    """Corrupt, truncated, or oversized control-plane frame.
+
+    Subclasses ``ConnectionError`` deliberately: every dead-peer
+    handler (``_PEER_GONE``, ``QueueCommunicator`` drop paths) already
+    treats the peer as gone, which is the right response to a peer
+    whose byte stream can no longer be trusted."""
+
 
 def send_recv(conn, sdata):
     """One request/reply round trip."""
@@ -38,8 +54,11 @@ class FramedConnection:
     ``close``/``fileno``) so every layer above can hold either.
     """
 
-    def __init__(self, sock: socket.socket):
+    def __init__(self, sock: socket.socket,
+                 max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES):
         self.sock = sock
+        self.max_frame_bytes = int(max_frame_bytes
+                                   or DEFAULT_MAX_FRAME_BYTES)
 
     def fileno(self):
         return self.sock.fileno()
@@ -57,20 +76,33 @@ class FramedConnection:
             n = self.sock.send(buf[:CHUNK])
             buf = buf[n:]
 
-    def _recv_exact(self, n: int) -> bytes:
+    def _recv_exact(self, n: int, what: str = "frame") -> bytes:
         chunks = io.BytesIO()
         remaining = n
         while remaining:
             data = self.sock.recv(remaining)
             if not data:
+                got = n - remaining
+                if got:
+                    # mid-frame close: the stream is corrupt, not
+                    # merely finished
+                    raise FrameError(
+                        f"truncated {what}: peer closed after "
+                        f"{got} of {n} bytes")
                 raise ConnectionResetError("peer closed")
             chunks.write(data)
             remaining -= len(data)
         return chunks.getvalue()
 
     def recv(self) -> Any:
-        (length,) = struct.unpack("!I", self._recv_exact(4))
-        return pickle.loads(self._recv_exact(length))
+        (length,) = struct.unpack("!I", self._recv_exact(4, "header"))
+        if length > self.max_frame_bytes:
+            # validate BEFORE allocating: a garbage header must not
+            # demand a multi-GiB buffer
+            raise FrameError(
+                f"frame length {length} exceeds max_frame_bytes "
+                f"{self.max_frame_bytes} (corrupt header?)")
+        return pickle.loads(self._recv_exact(length, "payload"))
 
 
 # -- TCP helpers --------------------------------------------------------
@@ -84,17 +116,19 @@ def find_free_port() -> int:
     return port
 
 
-def open_socket_connection(address: str, port: int, reuse=False):
+def open_socket_connection(address: str, port: int, reuse=False,
+                           max_frame_bytes=DEFAULT_MAX_FRAME_BYTES):
     sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     sock.setsockopt(
         socket.SOL_SOCKET, socket.SO_REUSEADDR,
         sock.getsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR) | 1,
     )
     sock.connect((address, port))
-    return FramedConnection(sock)
+    return FramedConnection(sock, max_frame_bytes=max_frame_bytes)
 
 
-def accept_socket_connections(port: int, timeout=None, backlog=128):
+def accept_socket_connections(port: int, timeout=None, backlog=128,
+                              max_frame_bytes=DEFAULT_MAX_FRAME_BYTES):
     """Generator of connections; yields None on accept timeout so the
     caller's loop can check for shutdown.
 
@@ -110,7 +144,7 @@ def accept_socket_connections(port: int, timeout=None, backlog=128):
     while True:
         try:
             sock, _ = server.accept()
-            yield FramedConnection(sock)
+            yield FramedConnection(sock, max_frame_bytes=max_frame_bytes)
         except socket.timeout:
             yield None
 
@@ -226,6 +260,10 @@ class QueueCommunicator:
         self.output_queue = queue.Queue(maxsize=256)
         self.conns: Dict[Any, bool] = {}
         self._lock = threading.Lock()
+        # observability for the FleetRegistry: replies dropped because
+        # their peer died first, and peer-disconnect events
+        self.send_drops = 0
+        self.disconnects = 0
         for conn in conns:
             self.add_connection(conn)
         self.shutdown_flag = False
@@ -242,23 +280,34 @@ class QueueCommunicator:
     def connection_count(self):
         return len(self.conns)
 
+    def live_connections(self):
+        with self._lock:
+            return list(self.conns)
+
     def recv(self, timeout=None):
         return self.input_queue.get(timeout=timeout)
 
     def send(self, conn, send_data):
         self.output_queue.put((conn, send_data))
 
-    def add_connection(self, conn):
-        with self._lock:
-            self.conns[conn] = True
+    def drop_stats(self) -> Dict[str, int]:
+        """Drop counters for the learner's FleetRegistry / metrics."""
+        return {"send_drops": self.send_drops,
+                "disconnects": self.disconnects}
 
-    def disconnect(self, conn):
-        with self._lock:
-            self.conns.pop(conn, None)
-        try:
-            conn.close()
-        except OSError:
-            pass
+    def fleet_stats(self) -> Dict[str, int]:
+        """Fleet-health contribution for the per-epoch metrics record;
+        supervised subclasses add respawn/alive counts."""
+        return self.drop_stats()
+
+    def begin_drain(self):
+        """Shutdown is coming: child exits are expected from here on.
+        No-op at this level; supervised subclasses stop respawning."""
+
+    def report_stale(self, conn):
+        """A peer missed its heartbeats.  No-op at this level (remote
+        peers are dropped when their socket dies); supervised
+        subclasses evict the wedged child so it respawns."""
 
     def _send_loop(self):
         while not self.shutdown_flag:
@@ -266,10 +315,33 @@ class QueueCommunicator:
                 conn, send_data = self.output_queue.get(timeout=0.3)
             except queue.Empty:
                 continue
+            with self._lock:
+                live = conn in self.conns
+            if not live:
+                # the peer died between enqueue and write: drop and
+                # count instead of feeding the daemon thread an
+                # exception on a closed handle
+                self.send_drops += 1
+                continue
             try:
                 conn.send(send_data)
             except (ConnectionResetError, BrokenPipeError, OSError):
+                self.send_drops += 1
                 self.disconnect(conn)
+
+    def add_connection(self, conn):
+        with self._lock:
+            self.conns[conn] = True
+
+    def disconnect(self, conn):
+        with self._lock:
+            removed = self.conns.pop(conn, None) is not None
+        if removed:
+            self.disconnects += 1
+        try:
+            conn.close()
+        except OSError:
+            pass
 
     def _recv_loop(self):
         while not self.shutdown_flag:

@@ -51,6 +51,7 @@ from .batch import make_batch
 from .connection import MultiProcessJobExecutor
 from .environment import make_env, prepare_env
 from .models import TPUModel, snapshot_params
+from .resilience import FleetRegistry
 from .utils.profiling import SectionTimers, TraceWindow
 from .ops.losses import LossConfig
 from .ops.update import (
@@ -1133,6 +1134,13 @@ class Learner:
 
         self.worker = WorkerServer(self.args) if remote \
             else WorkerCluster(self.args)
+        # fleet health: every control-plane message timestamps its
+        # peer; silence past heartbeat_timeout is a counted miss and
+        # an eviction (respawn) for supervised local gathers
+        self.fleet = FleetRegistry(
+            heartbeat_timeout=float(
+                self.args.get("heartbeat_timeout", 30.0) or 30.0))
+        self._last_sweep = 0.0
         self.trainer = Trainer(self.args, self.model)
         self.replay = ReplayBuffer(
             self.trainer.episodes, self.args["maximum_episodes"])
@@ -1318,12 +1326,97 @@ class Learner:
         self.update_model(model, steps)
         record["steps"] = steps
         record.update(getattr(self.trainer, "last_metrics", {}))
+        record.update(self._fleet_record())
         if self.metrics_path and self.primary:
             with open(self.metrics_path, "a") as f:
                 f.write(json.dumps(record) + "\n")
         self.replay.warned = False
 
+    # -- fleet health -----------------------------------------------
+    def _fleet_record(self):
+        """Per-epoch fleet metrics (fleet_size / respawns /
+        heartbeat_misses / conn_drops), reported next to the guard
+        counters in metrics.jsonl.  Degradation is LOUD but non-fatal:
+        a shrunken fleet slows episode intake, it does not stop
+        training."""
+        self.fleet.record_drops(self.worker.drop_stats())
+        snap = self.fleet.snapshot()
+        stats = self.worker.fleet_stats()
+        snap["respawns"] = stats.get("respawns", 0)
+        # expected strength: the supervisor's slot count for local
+        # fleets; for elastic remote fleets, the registry's sustained
+        # peak (updated at sweep time, after dead-peer reconciliation)
+        expected = stats.get("slots", self.fleet.peak_size)
+        if snap["fleet_size"] < expected:
+            print(f"WARNING: fleet degraded: {snap['fleet_size']} of "
+                  f"{expected} gathers responsive "
+                  f"({snap['respawns']} respawns, "
+                  f"{stats.get('slots_dead', 0)} slots dead); "
+                  "training continues on the surviving fleet")
+        return snap
+
+    def _sweep_fleet(self):
+        """Time-gated heartbeat expiry: newly stale peers are reported
+        to the communicator, which (for supervised local gathers)
+        evicts the wedged child so the supervisor respawns it."""
+        now = time.monotonic()
+        if now - self._last_sweep < 1.0:
+            return
+        # the loop normally passes here every ~0.3-1s; a much larger
+        # gap means THIS thread stalled (an epoch boundary inside
+        # update(), checkpoint I/O) while peer messages queued unread
+        stalled = self._last_sweep > 0.0 and now - self._last_sweep > 5.0
+        self._last_sweep = now
+        self._check_fleet_dead(now)
+        # peers whose connection the communicator already dropped
+        # (EOF/reset) are gone, not merely silent: forget them so
+        # fleet_size tracks the live fleet, and heartbeat misses count
+        # only wedged-but-connected peers
+        live = set(self.worker.live_connections())
+        for peer in self.fleet.peers():
+            if peer not in live:
+                self.fleet.forget(peer)
+        if stalled:
+            # the silence was ours, not the peers': refresh everyone
+            # rather than mass-evicting a healthy fleet whose proof of
+            # life is still sitting in the input queue
+            self.fleet.pardon(now)
+            return
+        for conn in self.fleet.sweep(now):
+            self.worker.report_stale(conn)
+
+    def _check_fleet_dead(self, now):
+        """Every supervised gather slot circuit-broke: nothing can
+        ever rejoin a LOCAL fleet (no accept port), so a silent idle
+        spin would hang the run forever — shut down cleanly instead.
+        Multi-host replicas cannot unilaterally exit the collective,
+        so they (and elastic remote servers, which lack a supervisor)
+        only warn, loudly and repeatedly."""
+        stats = self.worker.fleet_stats()
+        slots = stats.get("slots", 0)
+        if (not slots or stats.get("fleet_alive", 1) > 0
+                or stats.get("slots_dead", 0) < slots
+                or self.shutdown_flag):
+            return
+        if not self.multihost:
+            print("ERROR: the entire local gather fleet is dead "
+                  "(circuit breaker tripped on every slot); shutting "
+                  "down — raise max_respawns or fix the crash in the "
+                  "gather/worker logs")
+            self.shutdown_flag = True
+            self.worker.begin_drain()
+            self.trainer.request_shutdown()
+        elif now - getattr(self, "_fleet_dead_warned", 0.0) > 30.0:
+            self._fleet_dead_warned = now
+            print("WARNING: this process's entire gather fleet is "
+                  "dead; training is starved of episodes")
+
     # -- server loop -------------------------------------------------
+    def _on_beat(self, beats):
+        # liveness bookkeeping happened in the server loop (the
+        # registry needs the conn identity); the beat just needs an ack
+        return [None for _ in beats]
+
     def _on_args(self, requests):
         if self.shutdown_flag:
             return [None for _ in requests]
@@ -1347,6 +1440,7 @@ class Learner:
             "episode": self._on_episode,
             "result": self._on_result,
             "model": self._on_model,
+            "beat": self._on_beat,
         }
         next_epoch_at = (self.args["minimum_episodes"]
                          + self.args["update_episodes"])
@@ -1356,8 +1450,10 @@ class Learner:
                 conn, (verb, payload) = self.worker.recv(timeout=0.3)
             except queue.Empty:
                 conn = None  # epoch checks below still run on idle
+            self._sweep_fleet()
 
             if conn is not None:
+                self.fleet.observe(conn, verb, payload)
                 # gathers batch requests into lists; single requests
                 # get a single reply back
                 batched = isinstance(payload, list)
@@ -1378,6 +1474,7 @@ class Learner:
                     self.update()
                 if self.trainer.shutdown_flag:
                     self.shutdown_flag = True
+                    self.worker.begin_drain()
             # episodes drained from worker pools after shutdown still
             # land in the buffer but must not start extra epochs
             elif (self.episodes_received >= next_epoch_at
@@ -1386,6 +1483,9 @@ class Learner:
                 self.update()
                 if 0 <= self.args["epochs"] <= self.model_epoch:
                     self.shutdown_flag = True
+                    # workers drain from here: gather exits become
+                    # expected completions, not respawnable crashes
+                    self.worker.begin_drain()
         print("finished server")
 
     def _league_opponent(self):

@@ -1,0 +1,34 @@
+"""Fault tolerance for the actor fleet and the control plane.
+
+Three pillars (docs/large_scale_training.md "Fault tolerance"):
+
+  * :mod:`.supervisor` — child-process supervision: detect exits and
+    missed heartbeats, respawn with jittered exponential backoff, and
+    circuit-break a slot that keeps dying instead of restart-storming.
+  * :mod:`.health` — the learner-side :class:`FleetRegistry`:
+    per-gather last-seen / episode-rate / staleness bookkeeping behind
+    the ``fleet_size`` / ``respawns`` / ``heartbeat_misses`` metrics.
+  * :mod:`.chaos` — fault injection for tests: kill children at
+    configured rates/points, delay/drop/truncate control-plane frames.
+
+Everything here is plain-Python process plumbing: no jax, no device
+state.  The data plane (XLA collectives inside jitted programs) has its
+own failure story — a dead pod host fails the ``jax.distributed``
+heartbeat and the job restarts from the last checkpoint
+(`restart_epoch`); this package makes the CONTROL plane (actors,
+gathers, episode intake) survive the same churn without a restart.
+"""
+
+from .chaos import ChaosConfig, ChaosConnection, ChaosMonkey
+from .health import FleetRegistry
+from .supervisor import BackoffPolicy, SlotState, Supervisor
+
+__all__ = [
+    "BackoffPolicy",
+    "ChaosConfig",
+    "ChaosConnection",
+    "ChaosMonkey",
+    "FleetRegistry",
+    "SlotState",
+    "Supervisor",
+]

@@ -1,0 +1,183 @@
+"""Fault injection: kill children, corrupt control-plane frames.
+
+Nothing in CI used to EXERCISE a failure — the supervision and framing
+hardening in this package would otherwise be dead code with green
+tests.  The chaos harness makes failure a configured input:
+
+  * :class:`ChaosMonkey` kills supervised children at a configured
+    rate/point; the e2e chaos test arms it via the ``chaos:`` config
+    section and asserts training still completes with ``respawns >= 1``.
+  * :class:`ChaosConnection` wraps a connection and drops, delays, or
+    truncates whole frames, driving the receiver's ``FrameError`` /
+    dead-peer paths in unit tests.
+
+All randomness flows through one injectable RNG (``seed`` in the
+config), so chaos tests are seedable and non-flaky.
+"""
+
+import pickle
+import random
+import struct
+import time
+from dataclasses import dataclass, fields
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclass
+class ChaosConfig:
+    """The ``chaos:`` config section (docs/parameters.md).
+
+    Everything defaults off; a run with an empty section is exactly a
+    run without one.  Probabilities are per opportunity: per
+    supervision tick for ``kill_prob``, per sent frame for the
+    ``frame_*`` knobs.
+    """
+
+    kill_prob: float = 0.0        # P(kill one running child) per tick
+    kill_after: float = 0.0       # seconds after arm before kills start
+    max_kills: int = 0            # total kill budget; 0 = unlimited
+    frame_drop_prob: float = 0.0      # P(frame silently vanishes)
+    frame_truncate_prob: float = 0.0  # P(frame cut mid-payload + close)
+    frame_delay_prob: float = 0.0     # P(frame delayed by frame_delay)
+    frame_delay: float = 0.05         # seconds per injected delay
+    seed: int = 0                 # seeds the shared chaos RNG
+
+    @classmethod
+    def from_config(cls, raw: Optional[Dict[str, Any]]) -> "ChaosConfig":
+        raw = dict(raw or {})
+        known = {f.name for f in fields(cls)}
+        unknown = set(raw) - known
+        if unknown:
+            raise ValueError(f"unknown chaos keys: {sorted(unknown)}")
+        cfg = cls(**raw)
+        for name in ("kill_prob", "frame_drop_prob",
+                     "frame_truncate_prob", "frame_delay_prob"):
+            p = getattr(cfg, name)
+            if not 0.0 <= p <= 1.0:
+                raise ValueError(f"chaos.{name} must be in [0, 1]")
+        for name in ("kill_after", "frame_delay"):
+            if getattr(cfg, name) < 0:
+                raise ValueError(f"chaos.{name} must be >= 0")
+        if cfg.max_kills < 0:
+            raise ValueError("chaos.max_kills must be >= 0")
+        total = (cfg.frame_drop_prob + cfg.frame_truncate_prob
+                 + cfg.frame_delay_prob)
+        if total > 1.0:
+            # one uniform draw picks at most one fault per frame, so
+            # the configured rates only hold when they sum to <= 1
+            raise ValueError(
+                f"chaos frame probabilities must sum to <= 1 "
+                f"(got {total:g})")
+        return cfg
+
+    @property
+    def kills_enabled(self) -> bool:
+        return self.kill_prob > 0.0
+
+    @property
+    def frames_enabled(self) -> bool:
+        return (self.frame_drop_prob > 0.0
+                or self.frame_truncate_prob > 0.0
+                or self.frame_delay_prob > 0.0)
+
+
+class ChaosMonkey:
+    """Kills supervised children on a seeded schedule.
+
+    Drive it from the supervision loop: ``maybe_kill(supervisor)`` once
+    per tick.  Kills route through ``Supervisor.kill_slot`` so the
+    victim dies exactly the way a preempted host does — and the normal
+    failure -> backoff -> respawn path takes over.
+    """
+
+    def __init__(self, cfg: ChaosConfig,
+                 rng: Optional[random.Random] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.cfg = cfg
+        self.rng = rng if rng is not None else random.Random(cfg.seed)
+        self.clock = clock
+        self.armed_at = clock()
+        self.kills = 0
+
+    def maybe_kill(self, supervisor, now: Optional[float] = None) -> bool:
+        cfg = self.cfg
+        if not cfg.kills_enabled:
+            return False
+        if cfg.max_kills and self.kills >= cfg.max_kills:
+            return False
+        if now is None:
+            now = self.clock()
+        if now - self.armed_at < cfg.kill_after:
+            return False
+        if self.rng.random() >= cfg.kill_prob:
+            return False
+        targets = supervisor.running_children()
+        if not targets:
+            return False
+        index, _ = targets[self.rng.randrange(len(targets))]
+        self.kills += 1
+        supervisor.kill_slot(index, reason=f"chaos kill #{self.kills}")
+        return True
+
+
+class ChaosConnection:
+    """A connection wrapper that injects frame-level faults on send.
+
+    Wraps anything with the connection duck type; the truncation fault
+    needs byte-level access and therefore requires the inner connection
+    to be a :class:`~handyrl_tpu.connection.FramedConnection` (it
+    writes a header promising the full payload, ships half, and closes
+    — exactly what a peer dying mid-send looks like on the wire).
+    One uniform draw per frame picks at most one fault, so configured
+    probabilities compose additively.
+    """
+
+    def __init__(self, inner, cfg: ChaosConfig,
+                 rng: Optional[random.Random] = None):
+        self.inner = inner
+        self.cfg = cfg
+        self.rng = rng if rng is not None else random.Random(cfg.seed)
+        self.dropped = 0
+        self.truncated = 0
+        self.delayed = 0
+
+    def fileno(self):
+        return self.inner.fileno()
+
+    def close(self):
+        self.inner.close()
+
+    def recv(self):
+        return self.inner.recv()
+
+    def _send_truncated(self, data: Any):
+        from ..connection import FramedConnection
+
+        if not isinstance(self.inner, FramedConnection):
+            self.dropped += 1  # pipes have no wire to cut: drop instead
+            return
+        payload = pickle.dumps(data, protocol=pickle.HIGHEST_PROTOCOL)
+        partial = struct.pack("!I", len(payload)) \
+            + payload[:max(1, len(payload) // 2)]
+        try:
+            self.inner.sock.sendall(partial)
+        finally:
+            self.inner.close()  # mid-frame death: the receiver must
+            #                     see a truncated payload, not a stall
+
+    def send(self, data: Any):
+        cfg = self.cfg
+        draw = self.rng.random()
+        if draw < cfg.frame_drop_prob:
+            self.dropped += 1
+            return
+        draw -= cfg.frame_drop_prob
+        if draw < cfg.frame_truncate_prob:
+            self.truncated += 1
+            self._send_truncated(data)
+            return
+        draw -= cfg.frame_truncate_prob
+        if draw < cfg.frame_delay_prob:
+            self.delayed += 1
+            time.sleep(cfg.frame_delay)
+        self.inner.send(data)

@@ -25,6 +25,7 @@ Ports (same numbers as the reference so operational docs carry over):
 """
 
 import copy
+import functools
 import pickle
 import queue
 import random
@@ -241,6 +242,12 @@ class Gather(QueueCommunicator):
         self.pending_uploads = {}
         self.pending_count = 0
         self.first_pending_t = 0.0
+        # heartbeats piggyback on the control plane: every learner
+        # round trip proves liveness, so an explicit ("beat", stats)
+        # goes out only after heartbeat_interval seconds of silence
+        self.heartbeat_interval = float(
+            args.get("heartbeat_interval", 2.0) or 0.0)
+        self._last_learner_io = time.monotonic()
 
         worker_conns = self._spawn_workers(args, gather_id)
         super().__init__(worker_conns)
@@ -262,7 +269,21 @@ class Gather(QueueCommunicator):
 
     def _ask_learner(self, request):
         self.learner_conn.send(request)
-        return self.learner_conn.recv()
+        reply = self.learner_conn.recv()
+        self._last_learner_io = time.monotonic()
+        return reply
+
+    def _beat_if_due(self):
+        """Explicit heartbeat after heartbeat_interval of silence so
+        the learner's FleetRegistry can tell idle from wedged/dead."""
+        if (self.heartbeat_interval > 0
+                and time.monotonic() - self._last_learner_io
+                >= self.heartbeat_interval):
+            self._ask_learner(("beat", {
+                "gather_id": self.gather_id,
+                "workers": self.connection_count(),
+                **self.drop_stats(),
+            }))
 
     def _serve_job(self, conn):
         if not self.job_queue:
@@ -311,6 +332,7 @@ class Gather(QueueCommunicator):
                 conn, (verb, payload) = self.recv(timeout=0.3)
             except queue.Empty:
                 self._flush_if_stale()
+                self._beat_if_due()
                 continue
             if verb == "args":
                 self._serve_job(conn)
@@ -323,13 +345,32 @@ class Gather(QueueCommunicator):
             self.flush_uploads()  # don't drop episodes at shutdown
 
 
+def _maybe_chaos_wrap(conn, args, gather_id):
+    """Frame-fault injection (``chaos.frame_*``) on this gather's
+    learner connection, with a per-slot deterministic RNG.  A dropped
+    request wedges the gather mid-round-trip — by design: the
+    learner's heartbeat eviction is what recovers it.  Returns the
+    connection unwrapped when no frame faults are configured."""
+    from .resilience import ChaosConfig, ChaosConnection
+
+    chaos = ChaosConfig.from_config(args.get("chaos") or {})
+    if not chaos.frames_enabled:
+        return conn
+    rng = random.Random((chaos.seed << 16) ^ gather_id)
+    return ChaosConnection(conn, chaos, rng=rng)
+
+
 def gather_loop(args, conn, gather_id):
     force_cpu_jax()
-    gather = Gather(args, conn, gather_id)
+    gather = Gather(args, _maybe_chaos_wrap(conn, args, gather_id),
+                    gather_id)
     try:
         gather.run()
     except _PEER_GONE:
-        pass  # learner went away: exit quietly
+        # learner went away MID-session: exit nonzero (quietly) so a
+        # supervising RemoteWorkerCluster counts a failure — only the
+        # drain path (workers done, run() returns) exits 0
+        raise SystemExit(1)
 
 
 def _default_num_gathers(num_parallel):
@@ -337,25 +378,99 @@ def _default_num_gathers(num_parallel):
 
 
 class WorkerCluster(QueueCommunicator):
-    """Local actor pool: gather processes connected over pipes."""
+    """Local actor pool: gather processes connected over pipes, kept
+    alive by a Supervisor.
+
+    A gather that crashes (or is evicted for missed heartbeats — see
+    ``report_stale``) is respawned with jittered exponential backoff;
+    a slot that keeps dying trips its circuit breaker and the fleet
+    shrinks instead of restart-storming (resilience.supervisor).  The
+    optional ``chaos:`` config section arms a ChaosMonkey against the
+    same supervisor so failure handling is testable end to end."""
+
+    POLL_INTERVAL = 0.2  # supervision tick, seconds
 
     def __init__(self, args):
         super().__init__()
         self.args = args
+        self.supervisor = None
+        self._monkey = None
+        self._slot_conns = {}
+
+    def _spawn_gather(self, slot):
+        """Supervisor spawn hook: fresh pipe + gather process for a
+        slot; the slot's previous (dead) connection is dropped."""
+        ours, theirs = _mp.Pipe(duplex=True)
+        # gathers spawn worker children, so they cannot be daemonic;
+        # they exit on their own once every worker disconnects
+        proc = _mp.Process(
+            target=gather_loop, args=(self.args, theirs, slot))
+        proc.start()
+        theirs.close()
+        old = self._slot_conns.get(slot)
+        if old is not None:
+            self.disconnect(old)
+        self._slot_conns[slot] = ours
+        self.add_connection(ours)
+        return proc
 
     def run(self):
+        from .resilience import (
+            BackoffPolicy,
+            ChaosConfig,
+            ChaosMonkey,
+            Supervisor,
+        )
+
         wcfg = self.args["worker"]
         wcfg.setdefault(
             "num_gathers", _default_num_gathers(wcfg["num_parallel"]))
-        for gather_id in range(wcfg["num_gathers"]):
-            ours, theirs = _mp.Pipe(duplex=True)
-            # gathers spawn worker children, so they cannot be daemonic;
-            # they exit on their own once every worker disconnects
-            _mp.Process(
-                target=gather_loop, args=(self.args, theirs, gather_id)
-            ).start()
-            theirs.close()
-            self.add_connection(ours)
+        rng = random.Random(self.args.get("seed", 0))
+        self.supervisor = Supervisor(
+            self._spawn_gather, wcfg["num_gathers"],
+            policy=BackoffPolicy(
+                base=float(self.args.get("respawn_backoff", 0.5) or 0.5),
+                rng=rng),
+            max_respawns=int(self.args.get("max_respawns", 5)),
+        )
+        self.supervisor.start_all()
+        chaos = ChaosConfig.from_config(self.args.get("chaos") or {})
+        if chaos.kills_enabled:
+            self._monkey = ChaosMonkey(chaos)
+        threading.Thread(target=self._supervise, daemon=True).start()
+
+    def _supervise(self):
+        while not self.shutdown_flag:
+            if self._monkey is not None:
+                self._monkey.maybe_kill(self.supervisor)
+            self.supervisor.poll()
+            time.sleep(self.POLL_INTERVAL)
+
+    def begin_drain(self):
+        # workers are about to receive their None jobs and exit; from
+        # here a gather exit is completion, not a crash
+        if self.supervisor is not None:
+            self.supervisor.stop()
+
+    def report_stale(self, conn):
+        """Learner-side heartbeat expiry: evict the wedged gather so
+        the supervisor respawns it."""
+        if self.supervisor is None:
+            return
+        for slot, slot_conn in self._slot_conns.items():
+            if slot_conn is conn:
+                self.supervisor.kill_slot(slot, reason="missed heartbeats")
+                return
+
+    def fleet_stats(self):
+        stats = super().fleet_stats()
+        if self.supervisor is not None:
+            stats.update(self.supervisor.stats())
+        return stats
+
+    def shutdown(self):
+        self.begin_drain()
+        super().shutdown()
 
 
 class WorkerServer(QueueCommunicator):
@@ -382,17 +497,50 @@ class WorkerServer(QueueCommunicator):
         conn.send(merged)
         conn.close()
 
+    def _safe_admit(self, conn):
+        """One guarded entry handshake: a peer preempted mid-handshake,
+        a corrupt frame, or a stray client talking garbage to the entry
+        port is normal churn — it must cost that one connection, never
+        the accept loop (which could otherwise never admit a machine
+        again).  Broad catch is deliberate: garbage bytes can surface
+        as UnpicklingError/KeyError/etc., and the loop must survive
+        all of them."""
+        try:
+            self._admit(conn)
+        except Exception as exc:  # noqa: BLE001 — see docstring
+            print(f"entry handshake failed ({exc!r}); dropping peer")
+            try:
+                conn.close()
+            except OSError:
+                pass
+
     def _entry_server(self):
         print(f"started entry server {ENTRY_PORT}")
-        for conn in accept_socket_connections(port=ENTRY_PORT):
+        for conn in accept_socket_connections(
+                port=ENTRY_PORT, max_frame_bytes=self._max_frame_bytes()):
             if conn is not None:
-                self._admit(conn)
+                self._safe_admit(conn)
 
     def _worker_server(self):
         print(f"started worker server {WORKER_PORT}")
-        for conn in accept_socket_connections(port=WORKER_PORT):
+        for conn in accept_socket_connections(
+                port=WORKER_PORT, max_frame_bytes=self._max_frame_bytes()):
             if conn is not None:
                 self.add_connection(conn)
+
+    def _max_frame_bytes(self):
+        from .connection import DEFAULT_MAX_FRAME_BYTES
+
+        return int(self.args.get("max_frame_bytes", 0)
+                   or DEFAULT_MAX_FRAME_BYTES)
+
+    def report_stale(self, conn):
+        """A remote gather missed its heartbeats: sever the socket so
+        its blocked round-trip fails, the gather exits nonzero, and
+        the worker machine's own supervisor respawns it.  (Local
+        fleets instead kill the child directly — WorkerCluster.)"""
+        print("dropping stale worker connection (missed heartbeats)")
+        self.disconnect(conn)
 
     def run(self):
         threading.Thread(target=self._entry_server, daemon=True).start()
@@ -410,37 +558,108 @@ def entry(worker_args):
 
 class RemoteWorkerCluster:
     """Worker-machine runtime: handshake on the entry port, then local
-    gathers each dialing the learner's worker port."""
+    gathers each dialing the learner's worker port.
+
+    Resilient by session: the entry handshake retries with backoff
+    until the learner answers; each gather slot is supervised
+    (crash/eviction -> reconnect-with-backoff respawn, a dial the
+    learner refuses counts as a failure of the same slot); and when
+    every slot has circuit-broken dead — the learner was gone long
+    enough to exhaust every slot's respawn budget — the cluster
+    RESUMES the session: it re-runs the entry handshake (re-fetching
+    the merged args, which may have changed across a learner restart)
+    and respawns the fleet, whose fresh workers re-fetch the current
+    model snapshot through their ModelCache on their first jobs."""
+
+    SESSION_POLL = 0.5  # supervision tick, seconds
 
     def __init__(self, args):
         args["address"] = gethostname()
         args.setdefault(
             "num_gathers", _default_num_gathers(args["num_parallel"]))
         self.args = args
+        self._rng = random.Random()
+
+    def _join(self, policy):
+        """Entry handshake, retried with backoff until the learner is
+        reachable; returns the merged config."""
+        attempt = 0
+        while True:
+            try:
+                return entry(self.args)
+            except OSError as exc:
+                delay = policy.delay(attempt)
+                attempt += 1
+                print(f"learner unreachable ({exc!r}); "
+                      f"retrying entry in {delay:.1f}s")
+                time.sleep(delay)
+
+    def _spawn_gather(self, merged, slot):
+        from .connection import DEFAULT_MAX_FRAME_BYTES
+
+        conn = open_socket_connection(
+            self.args["server_address"], WORKER_PORT,
+            max_frame_bytes=int(merged.get("max_frame_bytes", 0)
+                                or DEFAULT_MAX_FRAME_BYTES))
+        proc = _mp.Process(
+            target=gather_loop, args=(merged, conn, slot))
+        proc.start()
+        conn.close()
+        return proc
+
+    def _run_session(self, merged):
+        """One supervised fleet against one learner session; returns
+        once no slot is live — True for a clean drain (training
+        ended), False when the fleet was lost (learner gone
+        mid-session)."""
+        from .resilience import BackoffPolicy, Supervisor
+
+        supervisor = Supervisor(
+            functools.partial(self._spawn_gather, merged),
+            self.args["num_gathers"],
+            policy=BackoffPolicy(
+                base=float(merged.get("respawn_backoff", 0.5) or 0.5),
+                rng=self._rng),
+            max_respawns=int(merged.get("max_respawns", 5)),
+            # a gather that exits 0 drained its workers after the
+            # learner's None jobs — training ended; don't respawn it
+            # against a learner that is finishing (gather_loop exits
+            # nonzero when the learner vanishes mid-session)
+            treat_clean_exit_as_drain=True,
+        )
+        supervisor.start_all()
+        try:
+            while True:
+                # poll BEFORE the exit check: a child that died during
+                # the sleep must be recorded (-> backoff respawn)
+                # before the check can mistake it for session end
+                supervisor.poll()
+                if (supervisor.alive_count() == 0
+                        and supervisor.pending_count() == 0):
+                    # poll just ran, so every slot is DEAD or STOPPED
+                    # here — decide the verdict before terminate_all's
+                    # stop() relabels anything
+                    return (supervisor.dead_count() == 0
+                            and supervisor.stopped_count() > 0)
+                time.sleep(self.SESSION_POLL)
+        finally:
+            # also reached on a partial launch failure or Ctrl-C:
+            # gathers are non-daemonic and must not be orphaned
+            supervisor.terminate_all()
 
     def run(self):
-        merged = entry(self.args)
-        print(merged)
         from .environment import prepare_env
+        from .resilience import BackoffPolicy
 
-        prepare_env(merged["env"])
-        procs = []
-        try:
-            for gather_id in range(self.args["num_gathers"]):
-                conn = open_socket_connection(
-                    self.args["server_address"], WORKER_PORT)
-                proc = _mp.Process(
-                    target=gather_loop, args=(merged, conn, gather_id))
-                proc.start()
-                conn.close()
-                procs.append(proc)
-            while True:
-                time.sleep(100)
-        finally:
-            # also reached on a partial launch failure: gathers are
-            # non-daemonic and must not be orphaned
-            for proc in procs:
-                proc.terminate()
+        entry_policy = BackoffPolicy(rng=self._rng)
+        while True:
+            merged = self._join(entry_policy)
+            print(merged)
+            prepare_env(merged["env"])
+            drained = self._run_session(merged)
+            print("training session complete; waiting for the next "
+                  "learner" if drained
+                  else "gather fleet lost; re-entering the session")
 
 
 def worker_main(args, argv):

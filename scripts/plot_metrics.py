@@ -165,6 +165,29 @@ def plot(epochs, out_prefix):
                     bbox_inches="tight")
         print(f"wrote {out_prefix}_guards.png")
 
+    # fleet health (resilience.FleetRegistry via the metrics jsonl):
+    # fleet_size should sit flat at the configured gather count —
+    # dips are crashes, and matching respawn increments mean the
+    # supervisor brought the fleet back; a climbing heartbeat_misses
+    # or conn_drops line means gathers are wedging or dying faster
+    # than they respawn
+    fleet_keys = [k for k in ("fleet_size", "fleet_workers", "respawns",
+                              "heartbeat_misses", "conn_drops")
+                  if any(k in e for e in epochs)]
+    if fleet_keys:
+        fig, ax = plt.subplots(figsize=(8, 5))
+        for k in fleet_keys:
+            pts = [(x, e[k]) for x, e in zip(xs, epochs) if k in e]
+            if pts:
+                ax.plot(*zip(*pts), label=k, marker=".")
+        ax.set_xlabel("epoch")
+        ax.set_ylabel("count")
+        ax.legend()
+        ax.grid(alpha=0.3)
+        fig.savefig(out_prefix + "_fleet.png", dpi=120,
+                    bbox_inches="tight")
+        print(f"wrote {out_prefix}_fleet.png")
+
     # generation stats (mean +- std band)
     pts = [(x, e["generation_mean"], e.get("generation_std", 0.0))
            for x, e in zip(xs, epochs) if "generation_mean" in e]

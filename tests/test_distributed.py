@@ -204,10 +204,14 @@ def test_gather_tree_scales_to_16_workers():
                 reply = [None] * n
             cluster.send(conn, reply if batched else reply[0])
     finally:
-        # shut the tree down: answer every further job request with
-        # None until the gather's connection actually closes — a fixed
-        # window could leave non-daemonic gather/worker processes
-        # alive and hang pytest at interpreter exit
+        # shut the tree down: gather exits are expected from here on
+        # (without begin_drain the supervisor would respawn the
+        # cleanly-exiting gather), then answer every further job
+        # request with None until the gather's connection actually
+        # closes — a fixed window could leave non-daemonic
+        # gather/worker processes alive and hang pytest at
+        # interpreter exit
+        cluster.begin_drain()
         drain_cap = time.time() + 90
         while cluster.connection_count() > 0 and time.time() < drain_cap:
             try:

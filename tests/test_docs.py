@@ -7,6 +7,7 @@ import os
 import re
 
 from handyrl_tpu.config import TrainConfig, WorkerConfig
+from handyrl_tpu.resilience.chaos import ChaosConfig
 
 DOCS = os.path.join(os.path.dirname(__file__), "..", "docs",
                     "parameters.md")
@@ -27,6 +28,8 @@ def _config_keys():
         keys.add("lambda" if field.name == "lambda_" else field.name)
     for field in dataclasses.fields(WorkerConfig):
         keys.add(field.name)
+    for field in dataclasses.fields(ChaosConfig):
+        keys.add(field.name)  # the documented chaos.* sub-keys
     keys.update({"env", "opponent"})  # env_args.env + eval.opponent
     return keys
 
