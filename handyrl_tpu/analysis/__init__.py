@@ -15,11 +15,17 @@ trace-stable as the codebase grows:
     ``PartitionSpec`` consistency, collective/shard_map agreement,
     implicit resharding at jit boundaries, multihost control-flow
     divergence, and divisibility guarantees.
-  * :mod:`handyrl_tpu.analysis.guards` — runtime context managers that
-    measure what the linters cannot prove: ``RetraceGuard`` (compile
-    counts of the update step), ``HostTransferGuard`` (device->host
-    transfer counts per epoch), and ``ShardingContractGuard``
-    (resharding copies at the update step's boundary).
+  * :mod:`handyrl_tpu.analysis.commlint` + ``commrules`` — the
+    control-plane protocol/concurrency layer (``--comm``): builds the
+    package's ``(verb, payload)`` protocol graph (sent vs handled
+    verbs, request/reply round trips) and checks blocking recvs,
+    payload picklability, and fork safety around it.
+  * :mod:`handyrl_tpu.analysis.guards` — runtime guards that measure
+    what the linters cannot prove: ``RetraceGuard`` (compile counts of
+    the update step), ``HostTransferGuard`` (device->host transfer
+    counts per epoch), ``ShardingContractGuard`` (resharding copies at
+    the update step's boundary), and ``StallWatchdog`` (silent wedges
+    of the control-plane loops, per-epoch ``stall_events``).
 
 Guard classes are re-exported lazily (PEP 562) so importing the
 analysis package — e.g. from the jaxlint CLI — never pulls in jax.
@@ -27,7 +33,7 @@ analysis package — e.g. from the jaxlint CLI — never pulls in jax.
 
 _GUARD_EXPORTS = ("RetraceGuard", "RetraceError", "HostTransferGuard",
                   "HostTransferError", "ShardingContractGuard",
-                  "ShardingContractError")
+                  "ShardingContractError", "StallWatchdog")
 
 __all__ = list(_GUARD_EXPORTS)
 

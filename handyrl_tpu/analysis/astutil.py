@@ -380,6 +380,42 @@ def _enclosing_class(scope: Optional[FunctionInfo]) -> Optional[str]:
     return None
 
 
+def is_host_converter(pkg: "Package", module: ModuleInfo, scope,
+                      fn_expr) -> bool:
+    """Is this function VALUE a host converter?  ``jax.tree.map``
+    applied over such a function returns host data even when its tree
+    argument is on device — the ``tree.map(np.asarray, out)`` idiom
+    every actor-facing boundary uses.  Shared by the device-taint
+    lattice and commlint's payload scan so "what launders" has one
+    definition."""
+    if isinstance(fn_expr, ast.Lambda):
+        body = fn_expr.body
+        # unwrap trailing indexing: lambda a: np.asarray(a)[0]
+        while isinstance(body, ast.Subscript):
+            body = body.value
+        if isinstance(body, ast.Call):
+            inner = pkg.full_name(module, scope, body.func)
+            return inner in HOST_RESULT_FNS \
+                or (inner or "").startswith("numpy.")
+        return False
+    name = pkg.full_name(module, scope, fn_expr)
+    return name in HOST_RESULT_FNS or (name or "").startswith("numpy.")
+
+
+def launders_to_host(pkg: "Package", module: ModuleInfo, scope,
+                     call: ast.Call) -> bool:
+    """Does this CALL return host data regardless of its arguments'
+    device placement?  True for the host-result builtins/numpy and for
+    ``jax.tree.map`` over a host converter."""
+    name = pkg.full_name(module, scope, call.func)
+    if name is None:
+        return False
+    if name in HOST_RESULT_FNS or name.startswith("numpy."):
+        return True
+    return (name == "jax.tree.map" and bool(call.args)
+            and is_host_converter(pkg, module, scope, call.args[0]))
+
+
 # ---------------------------------------------------------------------
 # taint evaluation
 # ---------------------------------------------------------------------
@@ -760,6 +796,10 @@ class DeviceTaint(_TaintWalk):
     def result_taint(self, name, resolution, call, arg_taints, kw_taints):
         if name is not None:
             if name in HOST_RESULT_FNS or name.startswith("numpy."):
+                return False
+            if name == "jax.tree.map" and call.args \
+                    and is_host_converter(self.pkg, self.module,
+                                          self.fn, call.args[0]):
                 return False
             if name in DEVICE_PRODUCER_FNS or name.startswith(
                     DEVICE_PRODUCER_PREFIXES):

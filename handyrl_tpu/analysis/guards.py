@@ -28,6 +28,13 @@ knows:
     argument's HBM.  The static ``implicit-reshard`` rule catches the
     cases provable from source; this guard catches the rest (shardings
     threaded through config and checkpoints).
+  * :class:`StallWatchdog` samples the learner's control-plane loops
+    (server loop, communicator reader/writer threads): each loop beats
+    once per pass, and a loop silent past ``max_stall_seconds`` is a
+    counted ``stall_event`` with its thread's stack dumped once — the
+    runtime complement of commlint's ``unbounded-recv``/
+    ``reply-mismatch`` rules, catching the wedges the analyzer could
+    not prove (or that a suppression claimed were bounded).
 
 All are near-zero-cost (an isinstance check / an integer bump per
 event) and run armed in production: the learner feeds their per-epoch
@@ -35,7 +42,10 @@ deltas into the metrics jsonl, so a regression is visible on the same
 plots as the loss curves.
 """
 
+import sys
 import threading
+import time
+import traceback
 
 import jax
 import numpy as np
@@ -285,6 +295,121 @@ class ShardingContractGuard:
         delta = self.copies - self._last_snapshot
         self._last_snapshot = self.copies
         return delta
+
+
+class StallWatchdog:
+    """Samples registered control-plane loops for silent wedges.
+
+    ::
+
+        dog = StallWatchdog(max_stall_seconds=60.0)
+        dog.start()
+        while serving:
+            dog.beat("server")     # once per loop pass
+            ...
+        dog.stop()
+
+    Each watched loop calls :meth:`beat` once per pass (a dict store —
+    nanoseconds, safe from any thread).  A background sampler checks
+    every ``max_stall_seconds / 4``: a loop whose last beat is older
+    than the threshold transitions to STALLED — one counted
+    ``stall_event``, plus a one-shot stack dump of the silent thread
+    (via ``sys._current_frames``) so the log says *where* it is
+    blocked, not just that it is.  A loop that beats again recovers
+    and can stall again later (each episode counts once).
+
+    The learner arms one over its server loop and the communicator's
+    reader/writer threads and reports the per-epoch ``stall_events``
+    delta in the metrics jsonl next to ``retrace_count`` /
+    ``resharding_copies`` / the heartbeat stats; the steady-state
+    value is 0 because every control-plane wait in the package is
+    bounded (a timeout, a sweep, or a supervised peer — the commlint
+    ``unbounded-recv`` contract).  Any positive count means a wedge
+    the static analysis could not see: a blocked round trip whose
+    suppression reason turned out to be wrong, a handler that stopped
+    replying, a lock held across an epoch.
+
+    The clock is injectable so expiry tests are exact; with an
+    injected clock the sampler thread is usually left unstarted and
+    :meth:`sample` driven manually.
+    """
+
+    def __init__(self, max_stall_seconds: float = 60.0,
+                 clock=time.monotonic):
+        self.max_stall = float(max_stall_seconds or 60.0)
+        self.clock = clock
+        self.stall_events = 0
+        self._last_snapshot = 0
+        self._loops = {}  # name -> [last_beat, stalled, thread_ident]
+        self._lock = threading.Lock()
+        self._thread = None
+        self._stop = threading.Event()
+
+    # -- liveness intake --------------------------------------------
+    def beat(self, loop: str = "server"):
+        """Prove one loop alive (call once per loop pass)."""
+        now = self.clock()
+        with self._lock:
+            state = self._loops.get(loop)
+            if state is None:
+                self._loops[loop] = [now, False,
+                                     threading.get_ident()]
+            else:
+                state[0] = now
+                state[1] = False  # a beating loop has recovered
+                state[2] = threading.get_ident()
+
+    # -- sampling ----------------------------------------------------
+    def sample(self, now=None) -> int:
+        """One watchdog pass: returns how many loops NEWLY stalled."""
+        if now is None:
+            now = self.clock()
+        newly = []
+        with self._lock:
+            for name, state in self._loops.items():
+                if state[1] or now - state[0] <= self.max_stall:
+                    continue
+                state[1] = True
+                self.stall_events += 1
+                newly.append((name, now - state[0], state[2]))
+        for name, silent, ident in newly:
+            self._dump(name, silent, ident)
+        return len(newly)
+
+    def _dump(self, name, silent, ident):
+        frame = sys._current_frames().get(ident)
+        where = "".join(traceback.format_stack(frame)) if frame \
+            else "  <thread gone>\n"
+        print(f"WARNING: control-plane loop '{name}' silent for "
+              f"{silent:.1f}s (> max_stall_seconds={self.max_stall}); "
+              f"stack of the stalled thread:\n{where}", end="")
+
+    def snapshot(self) -> int:
+        """Stall events since the previous snapshot (per-epoch delta)."""
+        with self._lock:
+            delta = self.stall_events - self._last_snapshot
+            self._last_snapshot = self.stall_events
+            return delta
+
+    # -- sampler thread ----------------------------------------------
+    def start(self):
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self):
+        interval = max(0.5, self.max_stall / 4.0)
+        while not self._stop.wait(interval):
+            self.sample()
+
+    def stop(self):
+        self._stop.set()
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=5)
 
 
 class HostTransferGuard:

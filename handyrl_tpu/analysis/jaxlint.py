@@ -9,15 +9,19 @@ Usage::
     python -m handyrl_tpu.analysis.jaxlint handyrl_tpu/
     python -m handyrl_tpu.analysis.jaxlint --json handyrl_tpu/
     python -m handyrl_tpu.analysis.jaxlint --shard handyrl_tpu/
+    python -m handyrl_tpu.analysis.jaxlint --comm handyrl_tpu/
     python -m handyrl_tpu.analysis.jaxlint --sarif handyrl_tpu/
     python -m handyrl_tpu.analysis.jaxlint --list-rules
     handyrl-jaxlint handyrl_tpu/            # console-script entry
 
 ``--shard`` additionally runs the sharding/collective-consistency rule
 set (:mod:`.shardrules` — mesh-axis validity, implicit resharding,
-multihost divergence); ``--sarif`` emits SARIF 2.1.0 for GitHub code
-scanning; ``--exclude`` drops path prefixes (e.g. test fixtures) from
-directory scans.
+multihost divergence) and ``--comm`` the control-plane protocol/
+concurrency rule set (:mod:`.commrules` — unhandled/dead verbs, reply
+wedges, unbounded recvs, unpicklable payloads, fork safety); the flags
+compose.  ``--sarif`` emits SARIF 2.1.0 for GitHub code scanning;
+``--exclude`` drops path prefixes (e.g. test fixtures) from directory
+scans.  ``--list-rules`` always prints all three rule families.
 
 Exit status: 0 when clean, 1 when any finding survives suppression,
 2 on usage/IO errors.
@@ -201,19 +205,27 @@ def load_package(paths: List[str], exclude: Optional[List[str]] = None):
     return Package(modules), suppressions, errors
 
 
-def active_registry(shard: bool = False) -> Dict[str, "object"]:
+def active_registry(shard: bool = False,
+                    comm: bool = False) -> Dict[str, "object"]:
     """The rule registry in force: jaxlint's base rules, plus the
-    shardlint rules with ``shard=True``."""
-    if not shard:
-        return dict(RULES)
-    from .shardrules import SHARD_RULES
+    shardlint rules with ``shard=True`` and the commlint rules with
+    ``comm=True`` (the flags compose)."""
+    registry = dict(RULES)
+    if shard:
+        from .shardrules import SHARD_RULES
 
-    return {**RULES, **SHARD_RULES}
+        registry.update(SHARD_RULES)
+    if comm:
+        from .commrules import COMM_RULES
+
+        registry.update(COMM_RULES)
+    return registry
 
 
 def lint_paths(paths: List[str],
                select: Optional[List[str]] = None,
                shard: bool = False,
+               comm: bool = False,
                exclude: Optional[List[str]] = None) -> List[Finding]:
     """Run the (selected) rules over ``paths``; returns surviving
     findings sorted by location."""
@@ -224,7 +236,7 @@ def lint_paths(paths: List[str],
     ]
     compute_tracer_taint(package)
     compute_device_summaries(package)
-    registry = active_registry(shard)
+    registry = active_registry(shard, comm)
     active = [registry[r] for r in (select or sorted(registry))]
     for mod in package.modules.values():
         supp = suppressions[mod.path]
@@ -245,13 +257,14 @@ def lint_paths(paths: List[str],
 
 def lint_source(source: str, name: str = "<string>",
                 select: Optional[List[str]] = None,
-                shard: bool = False) -> List[Finding]:
+                shard: bool = False,
+                comm: bool = False) -> List[Finding]:
     """Lint one in-memory module (test/fixture helper)."""
     module = ModuleInfo(name, name, source)
     package = Package([module])
     compute_tracer_taint(package)
     compute_device_summaries(package)
-    registry = active_registry(shard)
+    registry = active_registry(shard, comm)
     supp = Suppressions(source, name)
     findings: List[Finding] = []
     if supp.skip_file:
@@ -364,6 +377,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--shard", action="store_true",
                         help="also run the sharding/collective-"
                              "consistency rules (shardlint)")
+    parser.add_argument("--comm", action="store_true",
+                        help="also run the control-plane protocol/"
+                             "concurrency rules (commlint)")
     parser.add_argument("--select", default=None,
                         help="comma-separated rule ids to run "
                              "(default: all)")
@@ -375,9 +391,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="print the rule registry and exit")
     args = parser.parse_args(argv)
 
-    registry = active_registry(args.shard)
+    registry = active_registry(args.shard, args.comm)
     if args.list_rules:
-        _print_rules(registry)
+        # the rule LISTING is documentation, not a gate: always show
+        # every registered family (jax + shard + comm) with its doc
+        _print_rules(active_registry(shard=True, comm=True))
         return 0
     if args.json and args.sarif:
         print("jaxlint: --json and --sarif are mutually exclusive",
@@ -396,7 +414,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     paths = args.paths or ["handyrl_tpu"]
     try:
         findings = lint_paths(paths, select=select, shard=args.shard,
-                              exclude=args.exclude)
+                              comm=args.comm, exclude=args.exclude)
     except FileNotFoundError as exc:
         print(f"jaxlint: no such path: {exc}", file=sys.stderr)
         return 2

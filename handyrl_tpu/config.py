@@ -160,6 +160,16 @@ class TrainConfig:
     # fails with FrameError instead of allocating gigabytes.  0 = the
     # built-in 1 GiB default
     max_frame_bytes: int = 0
+    # arm a StallWatchdog over the learner's control-plane loops
+    # (server loop + communicator reader/writer threads): a loop
+    # silent past max_stall_seconds is a counted `stall_events` in the
+    # metrics jsonl with a one-shot stack dump of the wedged thread
+    stall_watchdog: bool = True
+    # silence threshold for the watchdog, seconds.  Must comfortably
+    # exceed the longest legitimate pause of a watched loop (the epoch
+    # boundary beats through trainer.update(), so ordinary long epochs
+    # do not count)
+    max_stall_seconds: float = 60.0
     # chaos fault injection for resilience tests (keys: kill_prob,
     # kill_after, max_kills, frame_drop_prob, frame_truncate_prob,
     # frame_delay_prob, frame_delay, seed); empty = off
@@ -202,6 +212,8 @@ class TrainConfig:
                 raise ValueError(f"{key} must be >= 0")
         if self.respawn_backoff <= 0:
             raise ValueError("respawn_backoff must be > 0")
+        if self.max_stall_seconds <= 0:
+            raise ValueError("max_stall_seconds must be > 0")
         if self.heartbeat_timeout <= self.heartbeat_interval:
             raise ValueError(
                 "heartbeat_timeout must exceed heartbeat_interval")

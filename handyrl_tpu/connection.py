@@ -13,6 +13,14 @@ are sent in chunks so a slow peer cannot wedge the sender's buffer.
 
 import io
 import multiprocessing as mp
+# ``mp.connection`` is a lazily-bound submodule: it only exists after
+# something imports it (locally that was a Pipe construction).  A
+# remote-mode learner with device replay never builds a pipe, so the
+# recv loop's first ``mp.connection.wait`` would die with
+# AttributeError on the first worker connection — import it EXPLICITLY
+# (found live by the StallWatchdog: "recv_loop silent ... <thread
+# gone>" on a --train-server drive)
+import multiprocessing.connection  # noqa: F401
 import pickle
 import queue
 import random
@@ -44,6 +52,10 @@ class FrameError(ConnectionError):
 def send_recv(conn, sdata):
     """One request/reply round trip."""
     conn.send(sdata)
+    # every caller's peer is supervised or heartbeat-swept, so a wedged
+    # reply ends in eviction (learner sweep) or child respawn, never a
+    # silent forever-block
+    # jaxlint: disable=unbounded-recv -- wedge bounded by peer supervision / heartbeat sweep
     return conn.recv()
 
 
@@ -80,6 +92,7 @@ class FramedConnection:
         chunks = io.BytesIO()
         remaining = n
         while remaining:
+            # jaxlint: disable=unbounded-recv -- the framing layer's raw socket read: a dead peer raises, and a WEDGED peer is severed by the learner's heartbeat sweep (report_stale disconnects the socket, failing this recv)
             data = self.sock.recv(remaining)
             if not data:
                 got = n - remaining
@@ -227,7 +240,14 @@ class MultiProcessJobExecutor:
 
     def _sender(self):
         while not self.shutdown_flag:
-            conn = self.waiting_conns.get()
+            try:
+                # bounded wait so shutdown() actually releases this
+                # thread (a bare .get() would park it forever once the
+                # receiver stops returning conns — commlint
+                # unbounded-recv found exactly that wedge)
+                conn = self.waiting_conns.get(timeout=0.3)
+            except queue.Empty:
+                continue
             conn.send(next(self.send_generator))
 
     def _receiver(self):
@@ -235,6 +255,7 @@ class MultiProcessJobExecutor:
             ready = mp.connection.wait(self.conns, timeout=0.3)
             for conn in ready:
                 try:
+                    # jaxlint: disable=unbounded-recv -- wait() selected this conn: a message is pending
                     data = conn.recv()
                 except EOFError:
                     continue
@@ -264,6 +285,12 @@ class QueueCommunicator:
         # their peer died first, and peer-disconnect events
         self.send_drops = 0
         self.disconnects = 0
+        # runtime counterpart of commlint's unhandled-verb: requests
+        # whose verb no server handler knows, counted per verb name
+        self.unknown_verbs: Dict[str, int] = {}
+        # StallWatchdog beat callable (set by the learner): the writer
+        # and reader threads prove liveness once per loop pass
+        self.liveness_hook = None
         for conn in conns:
             self.add_connection(conn)
         self.shutdown_flag = False
@@ -290,10 +317,25 @@ class QueueCommunicator:
     def send(self, conn, send_data):
         self.output_queue.put((conn, send_data))
 
+    def note_unknown_verb(self, verb):
+        """An arriving request named a verb no handler knows.  Counted
+        per verb (surfaced as ``unknown_verbs`` in :meth:`drop_stats`
+        and the fleet metrics) and logged ONCE per verb name — a
+        version-skewed worker fleet can send thousands of these, and
+        the first line says everything the next ones would."""
+        verb = str(verb)
+        count = self.unknown_verbs.get(verb, 0)
+        self.unknown_verbs[verb] = count + 1
+        if count == 0:
+            print(f"WARNING: unknown control-plane verb {verb!r} "
+                  f"(version skew or a stray client?); replying empty "
+                  f"— further occurrences counted silently")
+
     def drop_stats(self) -> Dict[str, int]:
         """Drop counters for the learner's FleetRegistry / metrics."""
         return {"send_drops": self.send_drops,
-                "disconnects": self.disconnects}
+                "disconnects": self.disconnects,
+                "unknown_verbs": sum(self.unknown_verbs.values())}
 
     def fleet_stats(self) -> Dict[str, int]:
         """Fleet-health contribution for the per-epoch metrics record;
@@ -311,6 +353,9 @@ class QueueCommunicator:
 
     def _send_loop(self):
         while not self.shutdown_flag:
+            hook = self.liveness_hook
+            if hook is not None:
+                hook("send_loop")
             try:
                 conn, send_data = self.output_queue.get(timeout=0.3)
             except queue.Empty:
@@ -345,6 +390,9 @@ class QueueCommunicator:
 
     def _recv_loop(self):
         while not self.shutdown_flag:
+            hook = self.liveness_hook
+            if hook is not None:
+                hook("recv_loop")
             with self._lock:
                 conns = list(self.conns)
             if not conns:
@@ -356,6 +404,7 @@ class QueueCommunicator:
                 ready = []
             for conn in ready:
                 try:
+                    # jaxlint: disable=unbounded-recv -- wait() selected this conn: a frame is pending (a peer dying mid-frame raises, it does not block)
                     data = conn.recv()
                 except (ConnectionResetError, BrokenPipeError, EOFError,
                         OSError):

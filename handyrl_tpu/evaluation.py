@@ -62,6 +62,7 @@ class NetworkAgentClient:
     def run(self):
         while True:
             try:
+                # jaxlint: disable=unbounded-recv -- server-driven session: the server sends "quit" at series end, and a dead server raises here
                 verb, payload = self.conn.recv()
             except (ConnectionResetError, EOFError):
                 break
@@ -89,6 +90,7 @@ class NetworkAgent:
 
     def _call(self, verb, *payload):
         self.conn.send((verb, list(payload)))
+        # jaxlint: disable=unbounded-recv -- request/reply over a live match connection; a dead client raises ConnectionError instead of blocking
         return self.conn.recv()
 
     def update(self, data, reset):
@@ -102,6 +104,16 @@ class NetworkAgent:
 
     def observe(self, player):
         return self._call("observe", player)
+
+    def quit(self):
+        """End the client's session.  Fire-and-forget by protocol: the
+        client breaks its recv loop without replying, so this must NOT
+        wait for one (a ``send_recv`` here would wedge forever — the
+        exact shape commlint's reply-mismatch rule exists for)."""
+        try:
+            self.conn.send(("quit", []))
+        except (ConnectionError, OSError):
+            pass  # client already gone: the session is over either way
 
 
 # ---------------------------------------------------------------------
@@ -284,6 +296,7 @@ def _match_series_child(agents, critic, env_args, index, in_queue,
     random.seed(seed + index)
     env = make_env({**env_args, "id": index})
     while True:
+        # jaxlint: disable=unbounded-recv -- the parent enqueues one None sentinel per child after the jobs, so this drain always terminates
         job = in_queue.get()
         if job is None:
             break
@@ -301,6 +314,13 @@ def _match_series_child(agents, critic, env_args, index, in_queue,
             outcome = exec_match(env, seats, critic, show=show,
                                  game_args=game_args)
         out_queue.put((pattern, agent_ids, outcome))
+    # series over: release remote clients so they exit their recv
+    # loops promptly instead of wedging until process teardown (the
+    # "quit" verb was handled client-side but never sent — commlint's
+    # dead-handler found the missing half of the protocol)
+    for agent in agents:
+        if isinstance(agent, NetworkAgent):
+            agent.quit()
     out_queue.put(None)
 
 
@@ -343,6 +363,7 @@ def evaluate_mp(env, agents, critic, env_args, args_patterns, num_process,
     table = ResultTable(len(agents))
     live_children = num_process
     while live_children > 0:
+        # jaxlint: disable=unbounded-recv -- every child posts a None sentinel on exit (even after env failures), so this loop always drains
         item = out_queue.get()
         if item is None:
             live_children -= 1
@@ -470,6 +491,7 @@ def eval_client_main(args, argv):
         try:
             host = argv[1] if len(argv) >= 2 else "localhost"
             conn = open_socket_connection(host, NETWORK_PORT)
+            # jaxlint: disable=unbounded-recv -- one-shot startup handshake: the server sends env_args immediately on accept, and a dead server raises out of the session loop
             env_args = conn.recv()
         except (EOFError, ConnectionError, OSError):
             break

@@ -46,6 +46,7 @@ from .analysis.guards import (
     HostTransferGuard,
     RetraceGuard,
     ShardingContractGuard,
+    StallWatchdog,
 )
 from .batch import make_batch
 from .connection import MultiProcessJobExecutor
@@ -101,6 +102,7 @@ def _batch_worker(conn, bid, cfg):
     print(f"started batcher {bid}")
     try:
         while True:
+            # jaxlint: disable=unbounded-recv -- batcher child on a parent pipe: learner death breaks the pipe and the except below exits the process
             episodes = conn.recv()
             batch = make_batch(episodes, cfg)
             conn.send(batch)
@@ -388,6 +390,7 @@ class Trainer:
         self.update_flag = False
         self.shutdown_flag = False
         self.failure = None
+        self.stall_beat = None   # StallWatchdog beat (set by Learner)
         self.update_queue = queue.Queue(maxsize=1)
         # multi-host: this process is one controller of a global mesh;
         # its feed builds 1/process_count of every global batch
@@ -723,6 +726,11 @@ class Trainer:
         blocking forever on a queue no one will fill."""
         self.update_flag = True
         while True:
+            if self.stall_beat is not None:
+                # the caller IS the server loop: keep its watchdog fed
+                # while a long epoch finishes, so "slow epoch" and
+                # "wedged server" stay distinguishable
+                self.stall_beat("server")
             try:
                 return self.update_queue.get(timeout=1)
             except queue.Empty:
@@ -1145,6 +1153,20 @@ class Learner:
         self.replay = ReplayBuffer(
             self.trainer.episodes, self.args["maximum_episodes"])
         self.metrics_path = self.args.get("metrics_path") or ""
+        # stall watchdog: the server loop and the communicator's
+        # reader/writer threads beat once per pass; a loop silent past
+        # max_stall_seconds is a counted stall_event with a stack dump
+        # (the runtime twin of commlint's unbounded-recv rule)
+        self.stall_watchdog = None
+        if self.args.get("stall_watchdog", True):
+            self.stall_watchdog = StallWatchdog(
+                max_stall_seconds=float(
+                    self.args.get("max_stall_seconds", 60.0) or 60.0))
+            self.worker.liveness_hook = self.stall_watchdog.beat
+            # the epoch boundary waits inside trainer.update(); beating
+            # there keeps a LONG epoch distinct from a wedged server
+            self.trainer.stall_beat = self.stall_watchdog.beat
+            self.stall_watchdog.start()
 
     def _initial_model(self, net):
         if net is not None:
@@ -1327,6 +1349,11 @@ class Learner:
         record["steps"] = steps
         record.update(getattr(self.trainer, "last_metrics", {}))
         record.update(self._fleet_record())
+        if self.stall_watchdog is not None:
+            # control-plane wedges this epoch (server loop + reader/
+            # writer threads silent past max_stall_seconds); steady
+            # state is 0 — see analysis.guards.StallWatchdog
+            record["stall_events"] = self.stall_watchdog.snapshot()
         if self.metrics_path and self.primary:
             with open(self.metrics_path, "a") as f:
                 f.write(json.dumps(record) + "\n")
@@ -1446,6 +1473,8 @@ class Learner:
                          + self.args["update_episodes"])
 
         while self.worker.connection_count() > 0 or not self.shutdown_flag:
+            if self.stall_watchdog is not None:
+                self.stall_watchdog.beat("server")
             try:
                 conn, (verb, payload) = self.worker.recv(timeout=0.3)
             except queue.Empty:
@@ -1459,7 +1488,13 @@ class Learner:
                 batched = isinstance(payload, list)
                 handler = handlers.get(verb)
                 if handler is None:
-                    # unknown verb from a stray client: shrug
+                    # unknown verb (version skew / stray client):
+                    # reply empty so the peer is not wedged, and COUNT
+                    # it — the runtime counterpart of commlint's
+                    # unhandled-verb, surfaced as `unknown_verbs` in
+                    # drop_stats()/the fleet metrics instead of being
+                    # an invisible shrug
+                    self.worker.note_unknown_verb(verb)
                     self.worker.send(conn, [] if batched else None)
                     continue
                 replies = handler(payload if batched else [payload])
@@ -1564,6 +1599,10 @@ class Learner:
             trainer_thread.join(timeout=30)
             self.trainer.stop_feeds()
             self.worker.shutdown()
+            if self.stall_watchdog is not None:
+                # after shutdown the loops stop beating by design; a
+                # late sample must not report teardown as a stall
+                self.stall_watchdog.stop()
 
 
 def _maybe_init_distributed(args):

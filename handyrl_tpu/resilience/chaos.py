@@ -148,6 +148,7 @@ class ChaosConnection:
         self.inner.close()
 
     def recv(self):
+        # jaxlint: disable=unbounded-recv -- transparent wrapper: boundedness (timeouts, heartbeat sweep) is the wrapped connection's property, and chaos only perturbs sends
         return self.inner.recv()
 
     def _send_truncated(self, data: Any):

@@ -159,7 +159,12 @@ class FleetRegistry:
             now = self.clock()
         with self._lock:
             fleet = self._live_count(now)
-            drops = sum(self._drops.values())
+            # unknown_verbs rides the same drop_stats() dict but is a
+            # protocol-skew signal, not a connection drop: surface it
+            # as its own metric instead of folding it into conn_drops
+            drops = sum(v for k, v in self._drops.items()
+                        if k != "unknown_verbs")
+            unknown = self._drops.get("unknown_verbs", 0)
             eps = self._eps_locked(now)
             # gather self-reports (best effort: carried by explicit
             # beats, so a gather busy enough to never beat reports 0)
@@ -172,5 +177,6 @@ class FleetRegistry:
             "fleet_workers": workers,
             "heartbeat_misses": self.heartbeat_misses,
             "conn_drops": drops,
+            "unknown_verbs": unknown,
             "fleet_eps_per_sec": round(eps, 3),
         }

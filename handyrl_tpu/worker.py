@@ -488,6 +488,7 @@ class WorkerServer(QueueCommunicator):
 
     def _admit(self, conn):
         """Entry handshake: reserve an id block, reply merged config."""
+        # jaxlint: disable=unbounded-recv -- bounded: _safe_admit arms a socket deadline before calling, so a silent peer raises timeout instead of wedging the entry loop
         remote_cfg = conn.recv()
         print(f"accepted connection from {remote_cfg['address']}")
         remote_cfg["base_worker_id"] = self.total_worker_count
@@ -506,6 +507,12 @@ class WorkerServer(QueueCommunicator):
         as UnpicklingError/KeyError/etc., and the loop must survive
         all of them."""
         try:
+            # a peer that connects and then says NOTHING must not park
+            # the entry thread forever (commlint unbounded-recv): give
+            # the whole handshake a deadline, after which the recv in
+            # _admit raises socket.timeout (an OSError) and the peer
+            # is dropped like any other garbage handshake
+            conn.sock.settimeout(10.0)
             self._admit(conn)
         except Exception as exc:  # noqa: BLE001 — see docstring
             print(f"entry handshake failed ({exc!r}); dropping peer")
@@ -551,6 +558,7 @@ def entry(worker_args):
     """Remote machine -> learner handshake; returns the merged config."""
     conn = open_socket_connection(worker_args["server_address"], ENTRY_PORT)
     conn.send(worker_args)
+    # jaxlint: disable=unbounded-recv -- one-shot startup handshake, operator-visible: the learner replies immediately on accept, and a dead learner raises into _join's retry loop
     merged = conn.recv()
     conn.close()
     return merged

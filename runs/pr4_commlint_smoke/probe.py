@@ -1,0 +1,12 @@
+import sys
+sys.path.insert(0, "/root/repo")
+from handyrl_tpu.connection import open_socket_connection
+conn = open_socket_connection("127.0.0.1", 9998)
+conn.send(("frobnicate", None))
+print("reply 1:", conn.recv(), flush=True)
+conn.send(("frobnicate", None))
+print("reply 2:", conn.recv(), flush=True)
+conn.send(("zap", [1, 2]))
+print("reply 3:", conn.recv(), flush=True)
+conn.close()
+print("probe done", flush=True)

@@ -149,7 +149,7 @@ def plot(epochs, out_prefix):
     # host_transfers is the per-epoch delta and must not grow with the
     # step count — a rising line on either is a hot-path regression
     guard_keys = [k for k in ("retrace_count", "host_transfers",
-                              "resharding_copies")
+                              "resharding_copies", "stall_events")
                   if any(k in e for e in epochs)]
     if guard_keys:
         fig, ax = plt.subplots(figsize=(8, 5))
@@ -172,7 +172,8 @@ def plot(epochs, out_prefix):
     # or conn_drops line means gathers are wedging or dying faster
     # than they respawn
     fleet_keys = [k for k in ("fleet_size", "fleet_workers", "respawns",
-                              "heartbeat_misses", "conn_drops")
+                              "heartbeat_misses", "conn_drops",
+                              "unknown_verbs")
                   if any(k in e for e in epochs)]
     if fleet_keys:
         fig, ax = plt.subplots(figsize=(8, 5))

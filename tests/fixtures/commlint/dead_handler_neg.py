@@ -1,0 +1,43 @@
+"""Negative: every handled verb is sent somewhere — including through
+a dispatch dict and a verb table."""
+
+
+class Worker:
+    def __init__(self, run_episode, run_eval):
+        # verb table: the reply verbs count as sent
+        self.roles = {
+            "g": (run_episode, "episode"),
+            "e": (run_eval, "result"),
+        }
+
+    def work(self, conn, job):
+        runner, reply_verb = self.roles[job["role"]]
+        conn.send((reply_verb, runner(job)))
+
+
+def client(conn):
+    conn.send(("ping", 1))
+
+
+def server(hub):
+    def on_ping(payload):
+        return payload
+
+    def on_episode(payload):
+        return None
+
+    def on_result(payload):
+        return None
+
+    handlers = {
+        "ping": on_ping,
+        "episode": on_episode,
+        "result": on_result,
+    }
+    while True:
+        conn, (verb, payload) = hub.recv(timeout=0.3)
+        handler = handlers.get(verb)
+        if handler is None:
+            hub.send(conn, None)
+            continue
+        hub.send(conn, handler(payload))

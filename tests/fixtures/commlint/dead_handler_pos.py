@@ -1,0 +1,14 @@
+"""Positive: a verb is handled but nothing in the package sends it."""
+
+
+def client(conn):
+    conn.send(("ping", 1))
+
+
+def server(hub):
+    while True:
+        conn, (verb, payload) = hub.recv(timeout=0.3)
+        if verb == "ping":
+            hub.send(conn, payload)
+        elif verb == "stats":   # nothing sends "stats" -> dead-handler
+            hub.send(conn, {})

@@ -1,0 +1,15 @@
+"""Suppressed: the dead handler carries a reasoned suppression."""
+
+
+def client(conn):
+    conn.send(("ping", 1))
+
+
+def server(hub):
+    while True:
+        conn, (verb, payload) = hub.recv(timeout=0.3)
+        if verb == "ping":
+            hub.send(conn, payload)
+        # jaxlint: disable=dead-handler -- sent by v1 workers still in the fleet during rolling upgrades
+        elif verb == "stats":
+            hub.send(conn, {})

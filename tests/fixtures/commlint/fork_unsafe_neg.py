@@ -1,0 +1,29 @@
+"""Negative: spawn contexts are always safe (fresh interpreter), and a
+default-context Process BEFORE any threads exist is fine too."""
+
+import multiprocessing as mp
+import threading
+
+_mp = mp.get_context("spawn")
+
+
+def spawn_after_threads(target):
+    t = threading.Thread(target=target)
+    t.start()
+    proc = _mp.Process(target=target)    # spawn context: safe
+    proc.start()
+    return proc
+
+
+def process_before_threads(target):
+    proc = mp.Process(target=target)     # no threads exist yet
+    proc.start()
+    t = threading.Thread(target=target)
+    t.start()
+    return proc
+
+
+def inline_spawn(target):
+    proc = mp.get_context("spawn").Process(target=target)
+    proc.start()
+    return proc

@@ -1,0 +1,29 @@
+"""Positive: forks after threads started, under a held lock, and a raw
+os.fork — the child inherits locks whose owners do not exist."""
+
+import multiprocessing as mp
+import os
+import threading
+
+
+def spawn_after_threads(target):
+    t = threading.Thread(target=target)
+    t.start()
+    proc = mp.Process(target=target)     # fork after threads started
+    proc.start()
+    return proc
+
+
+def fork_under_lock(target):
+    lock = threading.Lock()
+    with lock:
+        proc = mp.Process(target=target)  # fork while a lock is held
+        proc.start()
+    return proc
+
+
+def raw_fork(handler):
+    t = threading.Thread(target=handler)
+    t.start()
+    pid = os.fork()                      # os.fork after threads
+    return pid

@@ -1,0 +1,13 @@
+"""Suppressed: the fork-after-threads carries a reasoned suppression."""
+
+import multiprocessing as mp
+import threading
+
+
+def spawn_after_threads(target):
+    t = threading.Thread(target=target)
+    t.start()
+    # jaxlint: disable=fork-unsafe -- the started thread holds no locks and the child execs immediately; measured safe on this platform
+    proc = mp.Process(target=target)
+    proc.start()
+    return proc

@@ -1,0 +1,32 @@
+"""Negative: every round-trip handler replies — in-branch, through a
+helper that sends, or by falling through to the shared post-chain
+send; and a fire-and-forget verb may exit without replying."""
+
+
+def send_recv(conn, sdata):
+    conn.send(sdata)
+    return conn.recv(timeout=5)
+
+
+def client(conn):
+    reply = send_recv(conn, ("fetch", "key"))
+    send_recv(conn, ("store", reply))
+    conn.send(("bye", None))    # fire-and-forget: no reply expected
+    return reply
+
+
+class Server:
+    def _serve_fetch(self, hub, conn, payload):
+        hub.send(conn, {"value": payload})
+
+    def run(self, hub):
+        while True:
+            conn, (verb, payload) = hub.recv(timeout=0.3)
+            if verb == "fetch":
+                self._serve_fetch(hub, conn, payload)
+                continue
+            if verb == "bye":
+                break           # no reply needed: sender does not wait
+            if verb == "store":
+                payload = dict(payload)
+            hub.send(conn, payload)

@@ -1,0 +1,24 @@
+"""Positive: a request/reply verb whose handler can skip the reply —
+the sender's blocking recv would wedge forever."""
+
+
+def send_recv(conn, sdata):
+    conn.send(sdata)
+    return conn.recv(timeout=5)
+
+
+def client(conn):
+    return send_recv(conn, ("fetch", "key"))
+
+
+def record(payload):
+    pass
+
+
+def server(hub):
+    while True:
+        conn, (verb, payload) = hub.recv(timeout=0.3)
+        if verb == "fetch":     # handler never replies -> wedge
+            record(payload)
+            continue
+        hub.send(conn, None)

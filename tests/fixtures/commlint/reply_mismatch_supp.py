@@ -1,0 +1,25 @@
+"""Suppressed: the reply-skipping handler carries a reasoned
+suppression."""
+
+
+def send_recv(conn, sdata):
+    conn.send(sdata)
+    return conn.recv(timeout=5)
+
+
+def client(conn):
+    return send_recv(conn, ("fetch", "key"))
+
+
+def record(payload):
+    pass
+
+
+def server(hub):
+    while True:
+        conn, (verb, payload) = hub.recv(timeout=0.3)
+        # jaxlint: disable=reply-mismatch -- the reply is sent asynchronously by the flush thread once the batch commits
+        if verb == "fetch":
+            record(payload)
+            continue
+        hub.send(conn, None)

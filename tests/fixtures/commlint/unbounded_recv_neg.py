@@ -1,0 +1,48 @@
+"""Negative: every wait is bounded — a timeout, a settimeout on the
+listening socket, or a class that participates in the heartbeat
+protocol (its wedges are evicted by the learner's sweep)."""
+
+import queue
+
+
+def drain(conn, sink):
+    while True:
+        data = conn.recv(timeout=0.3)
+        sink.append(data)
+
+
+def pull(jobs):
+    try:
+        return jobs.get(timeout=1.0)
+    except queue.Empty:
+        return None
+
+
+def pull_forms(jobs, cfg):
+    first = jobs.get(False)         # non-blocking: raises Empty now
+    second = jobs.get(True, 2.0)    # get(block, timeout): bounded
+    limit = cfg.get("limit")        # dict read, not a wait
+    fallback = cfg.get("mode", "x")  # dict read with default
+    return first, second, limit, fallback
+
+
+def serve(sock):
+    sock.settimeout(1.0)
+    while True:
+        peer, addr = sock.accept()  # bounded by settimeout above
+        peer.close()
+
+
+class Gather:
+    """Heartbeat participant: a wedged round trip here is recovered by
+    the learner's FleetRegistry sweep, not by a local timeout."""
+
+    def __init__(self, conn):
+        self.conn = conn
+
+    def _beat_if_due(self):
+        self.conn.send("beat")
+
+    def ask(self, request):
+        self.conn.send(request)
+        return self.conn.recv()     # swept class: bounded by eviction

@@ -1,0 +1,27 @@
+"""Positive: blocking waits with no timeout and no sweep protection —
+one dead peer freezes each of these threads forever."""
+
+
+def drain(conn, sink):
+    while True:
+        data = conn.recv()          # no timeout -> unbounded-recv
+        sink.append(data)
+
+
+def pull(jobs):
+    item = jobs.get()               # no timeout -> unbounded-recv
+    return item
+
+
+def pull_blocking(jobs):
+    return jobs.get(True)           # block=True: the same forever-wait
+
+
+def read_frame(sock):
+    return sock.recv(4096)          # bufsize is not a timeout
+
+
+def serve(sock):
+    while True:
+        peer, addr = sock.accept()  # no settimeout -> unbounded-recv
+        peer.close()

@@ -1,0 +1,14 @@
+"""Negative: every sent verb has a handler (and dynamic verbs whose
+names the analyzer cannot resolve stay quiet)."""
+
+
+def client(conn, extra_verb):
+    conn.send(("ping", 1))
+    conn.send((extra_verb, 2))   # dynamic: no literal, no finding
+
+
+def server(hub):
+    while True:
+        conn, (verb, payload) = hub.recv(timeout=0.3)
+        if verb == "ping":
+            hub.send(conn, payload)

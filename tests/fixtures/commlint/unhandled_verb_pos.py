@@ -1,0 +1,13 @@
+"""Positive: a verb is sent but no receiver anywhere handles it."""
+
+
+def client(conn):
+    conn.send(("ping", 1))
+    conn.send(("zap", 2))   # no handler anywhere -> unhandled-verb
+
+
+def server(hub):
+    while True:
+        conn, (verb, payload) = hub.recv(timeout=0.3)
+        if verb == "ping":
+            hub.send(conn, payload)

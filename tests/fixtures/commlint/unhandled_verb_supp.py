@@ -1,0 +1,14 @@
+"""Suppressed: the unhandled send carries a reasoned suppression."""
+
+
+def client(conn):
+    conn.send(("ping", 1))
+    # jaxlint: disable=unhandled-verb -- consumed by an external monitoring sidecar outside this package
+    conn.send(("zap", 2))
+
+
+def server(hub):
+    while True:
+        conn, (verb, payload) = hub.recv(timeout=0.3)
+        if verb == "ping":
+            hub.send(conn, payload)

@@ -1,0 +1,29 @@
+"""Negative: plain data payloads — a lock used locally but not sent,
+and a device array converted to host numpy at the boundary."""
+
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def ship_state(conn):
+    lock = threading.Lock()
+    with lock:
+        payload = {"count": 1}
+    conn.send(payload)
+
+
+def ship_host(conn):
+    arr = jnp.zeros((4,))
+    conn.send(np.asarray(arr))  # host copy crosses the wire, not arr
+
+
+def ship_tree(conn):
+    import jax
+
+    out = {"logits": jnp.zeros((4,))}
+    # the boundary idiom: tree.map over a host converter launders the
+    # whole tree (one shared definition with the device-taint lattice)
+    conn.send(("batch", jax.tree.map(np.asarray, out)))
+    conn.send(("meta", type(out)))

@@ -1,0 +1,26 @@
+"""Positive: locks, open handles, lambdas, and jax device arrays
+flowing into framed sends — pickle raises, or (for device arrays) the
+send hides a device->host transfer."""
+
+import threading
+
+import jax.numpy as jnp
+
+
+def ship_lock(conn):
+    lock = threading.Lock()
+    conn.send(lock)             # unpicklable
+
+
+def ship_file(conn):
+    with open("stats.log") as handle:
+        conn.send(handle)       # unpicklable
+
+
+def ship_code(conn):
+    conn.send(lambda x: x + 1)  # unpicklable
+
+
+def ship_device(conn):
+    arr = jnp.zeros((4,))
+    conn.send(arr)              # hidden device->host transfer

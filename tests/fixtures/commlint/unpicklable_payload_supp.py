@@ -1,0 +1,9 @@
+"""Suppressed: the device-array send carries a reasoned suppression."""
+
+import jax.numpy as jnp
+
+
+def ship_device(conn):
+    arr = jnp.zeros((4,))
+    # jaxlint: disable=unpicklable-payload -- same-host pipe to a CPU-backend child; the one-off transfer is the cheapest correct option here
+    conn.send(arr)

@@ -1,0 +1,50 @@
+"""Shared pos/neg/supp fixture driver for the lint rule suites.
+
+The three rule families (jaxlint / shardlint / commlint) share one
+fixture convention — ``tests/fixtures/<family>/<rule>_pos.py`` must
+produce findings of exactly that rule, ``<rule>_neg.py`` and
+``<rule>_supp.py`` must produce none — and therefore one driver:
+``check_fixture(family, rule_id, kind, **lint_kwargs)`` runs the
+linter over the fixture with the family's flags and applies the
+kind's assertion.  The per-family test modules keep only their
+parametrization and family-specific tests.
+
+Fixtures are parsed, never imported.
+"""
+
+import os
+
+from handyrl_tpu.analysis.jaxlint import lint_paths
+
+FIXTURES_ROOT = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def fixture_path(family: str, rule_id: str, kind: str) -> str:
+    path = os.path.join(
+        FIXTURES_ROOT, family,
+        f"{rule_id.replace('-', '_')}_{kind}.py")
+    assert os.path.exists(path), f"missing fixture {path}"
+    return path
+
+
+def check_fixture(family: str, rule_id: str, kind: str, **lint_kwargs):
+    """Lint one fixture and assert its contract:
+
+    * ``pos``  — at least one finding, all of exactly ``rule_id``
+      (cross-rule noise on a positive means the families bleed);
+    * ``neg``/``supp`` — zero findings (false positive, or a
+      suppression not honored).
+    """
+    path = fixture_path(family, rule_id, kind)
+    findings = lint_paths([path], **lint_kwargs)
+    if kind == "pos":
+        assert findings, f"{rule_id} produced no findings on its positive"
+        assert all(f.rule == rule_id for f in findings), (
+            f"cross-rule noise on {rule_id}_pos: "
+            f"{[(f.rule, f.line) for f in findings]}")
+    else:
+        label = ("false positives" if kind == "neg"
+                 else "suppression not honored")
+        assert findings == [], (
+            f"{label} on {rule_id}_{kind}: "
+            f"{[(f.rule, f.line, f.message) for f in findings]}")

@@ -1,0 +1,310 @@
+"""commlint rule suite: every control-plane rule fires on its positive
+fixture, stays quiet on its negative, and obeys suppression comments —
+plus the protocol-graph machinery (verb tables, wrapper sends,
+return-verb summaries, spawn-context tracking), the unified-CLI
+surface (--comm), and the repo gate: the shipped package must comm-lint
+clean WITH the protocol graph verifiably populated (the real verbs of
+the learner/worker/evaluation planes must be discovered, or the gate
+would be vacuously green).
+
+Fixture convention (tests/fixtures/commlint/): ``<rule>_pos.py`` must
+produce findings of exactly that rule under the base+comm rule set,
+``<rule>_neg.py`` and ``<rule>_supp.py`` must produce none (driver
+shared with the base/shard suites: tests/lintfix.py).  The fixtures
+are parsed, never imported."""
+
+import json
+import os
+
+import pytest
+from lintfix import check_fixture, fixture_path
+
+from handyrl_tpu.analysis.commlint import analyze_comm
+from handyrl_tpu.analysis.commrules import COMM_RULES
+from handyrl_tpu.analysis.jaxlint import (
+    active_registry,
+    lint_paths,
+    lint_source,
+    load_package,
+    main,
+)
+from handyrl_tpu.analysis.rules import RULES
+from handyrl_tpu.analysis.shardrules import SHARD_RULES
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures",
+                        "commlint")
+REPO_PACKAGE = os.path.join(
+    os.path.dirname(__file__), "..", "handyrl_tpu")
+
+RULE_IDS = sorted(COMM_RULES)
+
+
+def fixture(rule_id, kind):
+    return fixture_path("commlint", rule_id, kind)
+
+
+@pytest.mark.parametrize("kind", ["pos", "neg", "supp"])
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_rule_fixture(rule_id, kind):
+    check_fixture("commlint", rule_id, kind, comm=True)
+
+
+def test_comm_registry_is_exactly_the_issue_rule_set():
+    assert set(RULE_IDS) == {
+        "unhandled-verb", "dead-handler", "reply-mismatch",
+        "unbounded-recv", "unpicklable-payload", "fork-unsafe"}
+
+
+def test_registries_do_not_collide():
+    # one suppression namespace across all three families
+    assert not set(COMM_RULES) & set(RULES)
+    assert not set(COMM_RULES) & set(SHARD_RULES)
+    combined = active_registry(shard=True, comm=True)
+    assert set(combined) == (
+        set(RULES) | set(SHARD_RULES) | set(COMM_RULES))
+
+
+def test_other_family_fixtures_stay_quiet_under_comm_rules():
+    """The base and shard fixtures must not trip the comm rules: the
+    three families stay independently testable."""
+    for family in ("jaxlint", "shardlint"):
+        tree = os.path.join(os.path.dirname(__file__), "fixtures",
+                            family)
+        findings = lint_paths([tree], comm=True,
+                              select=sorted(COMM_RULES))
+        assert findings == [], (
+            f"comm rules fired on {family} fixtures: "
+            f"{[(f.rule, f.path, f.line) for f in findings]}")
+
+
+def test_comm_fixtures_stay_quiet_under_shard_rules():
+    findings = lint_paths([FIXTURES], shard=True,
+                          select=sorted(SHARD_RULES))
+    assert findings == [], (
+        f"shard rules fired on comm fixtures: "
+        f"{[(f.rule, f.path, f.line) for f in findings]}")
+
+
+# -- protocol-graph machinery ------------------------------------------
+
+def test_wrapper_send_and_reply_expectation():
+    """A verb sent through a user-defined send+recv wrapper is
+    collected, and marked as expecting a reply."""
+    src = (
+        "class Cache:\n"
+        "    def _ask(self, request):\n"
+        "        self.conn.send(request)\n"
+        "        return self.conn.recv(timeout=5)\n\n"
+        "    def fetch(self, key):\n"
+        "        return self._ask(('model', key))\n")
+    from handyrl_tpu.analysis.astutil import ModuleInfo, Package
+
+    package = Package([ModuleInfo("m", "m", src)])
+    an = analyze_comm(package)
+    assert "model" in an.sent_verbs
+    assert all(s.expects_reply for s in an.sent_verbs["model"])
+
+
+def test_verb_head_parameter_wrapper():
+    """The ``self._call("update", data)`` shape: a literal verb passed
+    at the wrapper's verb-head parameter position."""
+    src = (
+        "class Stub:\n"
+        "    def _call(self, verb, *payload):\n"
+        "        self.conn.send((verb, list(payload)))\n"
+        "        return self.conn.recv(timeout=5)\n\n"
+        "    def update(self, data):\n"
+        "        return self._call('update', data)\n")
+    from handyrl_tpu.analysis.astutil import ModuleInfo, Package
+
+    package = Package([ModuleInfo("m", "m", src)])
+    an = analyze_comm(package)
+    assert "update" in an.sent_verbs
+
+
+def test_verb_table_unpack_flows_into_send():
+    """The worker's roles-table idiom: dict values ``(runner, verb)``
+    unpacked and used as a send head."""
+    src = (
+        "class Worker:\n"
+        "    def __init__(self, gen, ev):\n"
+        "        self.roles = {'g': (gen, 'episode'),\n"
+        "                      'e': (ev, 'result')}\n\n"
+        "    def work(self, conn, job):\n"
+        "        runner, reply_verb = self.roles[job['role']]\n"
+        "        conn.send((reply_verb, runner(job)))\n")
+    from handyrl_tpu.analysis.astutil import ModuleInfo, Package
+
+    package = Package([ModuleInfo("m", "m", src)])
+    an = analyze_comm(package)
+    assert {"episode", "result"} <= set(an.sent_verbs)
+
+
+def test_return_verb_summary_through_instance_attr():
+    """The pool idiom: a method returning literal ``(verb, payload)``
+    tuples, iterated by a caller that forwards each pair upstream —
+    resolved through a ``self.pool = Pool(...)`` instance attribute."""
+    src = (
+        "class Pool:\n"
+        "    def step(self, done):\n"
+        "        verb = 'episode' if done else 'result'\n"
+        "        return [(verb, None)]\n\n\n"
+        "class Worker:\n"
+        "    def __init__(self):\n"
+        "        self.pool = Pool()\n\n"
+        "    def pump(self, conn):\n"
+        "        pool = self.pool\n"
+        "        for verb, payload in pool.step(True):\n"
+        "            conn.send((verb, payload))\n")
+    from handyrl_tpu.analysis.astutil import ModuleInfo, Package
+
+    package = Package([ModuleInfo("m", "m", src)])
+    an = analyze_comm(package)
+    assert {"episode", "result"} <= set(an.sent_verbs)
+
+
+def test_spawn_context_tracked_cross_module():
+    """A spawn context constructed in one module stays recognized when
+    imported into another (the repo shape: connection._mp), while a
+    fork context in the same position is flagged."""
+    import tempfile
+
+    def build(tree_ctx):
+        tmp = tempfile.mkdtemp()
+        pkg = os.path.join(tmp, "pkg")
+        os.makedirs(pkg)
+        with open(os.path.join(pkg, "__init__.py"), "w") as f:
+            f.write("")
+        with open(os.path.join(pkg, "conn.py"), "w") as f:
+            f.write("import multiprocessing as mp\n"
+                    f"_mp = mp.get_context({tree_ctx!r})\n")
+        with open(os.path.join(pkg, "work.py"), "w") as f:
+            f.write(
+                "import threading\n"
+                "from .conn import _mp\n\n\n"
+                "def launch(target):\n"
+                "    t = threading.Thread(target=target)\n"
+                "    t.start()\n"
+                "    proc = _mp.Process(target=target)\n"
+                "    proc.start()\n"
+                "    return proc\n")
+        return pkg
+
+    assert lint_paths([build("spawn")], comm=True) == []
+    findings = lint_paths([build("fork")], comm=True)
+    assert [f.rule for f in findings] == ["fork-unsafe"]
+
+
+def test_dispatch_dict_handler_and_shrug_reply():
+    """The learner's exact server shape: dict dispatch with a send
+    after it, plus an unknown-verb shrug branch that still replies —
+    all quiet."""
+    src = (
+        "class Server:\n"
+        "    def on_ping(self, payload):\n"
+        "        return payload\n\n"
+        "    def run(self, hub, conn2):\n"
+        "        handlers = {'ping': self.on_ping}\n"
+        "        while True:\n"
+        "            conn, (verb, payload) = hub.recv(timeout=0.3)\n"
+        "            handler = handlers.get(verb)\n"
+        "            if handler is None:\n"
+        "                hub.send(conn, None)\n"
+        "                continue\n"
+        "            hub.send(conn, handler(payload))\n\n\n"
+        "def client(conn):\n"
+        "    conn.send(('ping', 1))\n")
+    assert lint_source(src, comm=True) == []
+
+
+# -- CLI ---------------------------------------------------------------
+
+def test_cli_comm_flag_runs_comm_rules(capsys):
+    rc = main(["--comm", "--json", fixture("unbounded-recv", "pos")])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["findings"]
+    assert all(f["rule"] == "unbounded-recv" for f in out["findings"])
+
+
+def test_cli_without_comm_flag_skips_comm_rules(capsys):
+    rc = main([fixture("unbounded-recv", "pos")])
+    assert rc == 0
+    assert "clean" in capsys.readouterr().out
+
+
+def test_cli_comm_composes_with_shard(capsys):
+    rc = main(["--comm", "--shard", "--json",
+               fixture("fork-unsafe", "pos")])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert all(f["rule"] == "fork-unsafe" for f in out["findings"])
+
+
+def test_cli_list_rules_shows_all_families_without_flags(capsys):
+    # the listing is documentation: every registered family prints,
+    # with or without --shard/--comm (the satellite contract)
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule_id in (sorted(RULES) + sorted(SHARD_RULES)
+                    + sorted(COMM_RULES)):
+        assert rule_id in out
+
+
+def test_cli_select_accepts_comm_rules_only_with_flag(capsys):
+    assert main(["--select", "unbounded-recv", FIXTURES]) == 2
+    capsys.readouterr()
+    rc = main(["--comm", "--select", "unbounded-recv",
+               fixture("unbounded-recv", "pos")])
+    assert rc == 1
+
+
+def test_cli_sarif_includes_comm_rules(capsys):
+    rc = main(["--comm", "--sarif", fixture("dead-handler", "pos")])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    rule_ids = {r["id"]
+                for r in doc["runs"][0]["tool"]["driver"]["rules"]}
+    assert set(COMM_RULES) <= rule_ids
+
+
+# -- repo gate ---------------------------------------------------------
+
+def test_repo_commlints_clean():
+    """The CI gate, enforced locally too: the shipped package must have
+    zero unsuppressed findings under the base+comm rule set."""
+    findings = lint_paths([REPO_PACKAGE], comm=True)
+    assert findings == [], "\n".join(
+        f"{f.location}: [{f.rule}] {f.message}" for f in findings)
+
+
+def test_repo_all_three_families_clean():
+    findings = lint_paths([REPO_PACKAGE], shard=True, comm=True)
+    assert findings == [], "\n".join(
+        f"{f.location}: [{f.rule}] {f.message}" for f in findings)
+
+
+def test_repo_protocol_graph_is_populated():
+    """The gate above is only meaningful if the analyzer actually SEES
+    the control plane: the real verbs of the worker plane (args/model/
+    episode/result/beat) and the network-battle plane (update/outcome/
+    action/observe/quit) must all be discovered as both sent and
+    handled — a refactor that hides the protocol from the analyzer
+    would otherwise silently disable all three graph rules."""
+    package, _, errors = load_package([REPO_PACKAGE])
+    assert errors == []
+    an = analyze_comm(package)
+    worker_plane = {"args", "model", "episode", "result", "beat"}
+    battle_plane = {"update", "outcome", "action", "observe", "quit"}
+    assert worker_plane <= set(an.sent_verbs), (
+        f"worker-plane verbs not discovered as sent: "
+        f"{worker_plane - set(an.sent_verbs)}")
+    assert worker_plane <= set(an.handled_verbs)
+    assert battle_plane <= set(an.sent_verbs), (
+        f"battle-plane verbs not discovered as sent: "
+        f"{battle_plane - set(an.sent_verbs)}")
+    assert battle_plane <= set(an.handled_verbs)
+    # round-trip semantics: model fetches expect replies, quit is
+    # fire-and-forget by protocol (its handler breaks without a reply)
+    assert all(s.expects_reply for s in an.sent_verbs["model"])
+    assert not any(s.expects_reply for s in an.sent_verbs["quit"])

@@ -9,6 +9,7 @@ import pickle
 import threading
 
 from handyrl_tpu.connection import (
+    QueueCommunicator,
     accept_socket_connections,
     find_free_port,
     open_socket_connection,
@@ -88,3 +89,35 @@ def test_checkpoint_retention_and_atomicity(tmp_path, monkeypatch):
         assert pickle.load(f)["epoch"] == 12
     with open(os.path.join("models", "latest.ckpt"), "rb") as f:
         assert pickle.load(f)["epoch"] == 12
+
+
+def test_unknown_verbs_counted_and_logged_once(capsys):
+    """The runtime counterpart of commlint's unhandled-verb: unknown
+    requests are counted per verb in drop_stats() and logged once per
+    verb name, not once per message."""
+    hub = QueueCommunicator()
+    try:
+        for _ in range(3):
+            hub.note_unknown_verb("frobnicate")
+        hub.note_unknown_verb("zap")
+        out = capsys.readouterr().out
+        assert out.count("'frobnicate'") == 1    # logged once
+        assert out.count("'zap'") == 1
+        stats = hub.drop_stats()
+        assert stats["unknown_verbs"] == 4
+        assert hub.unknown_verbs == {"frobnicate": 3, "zap": 1}
+    finally:
+        hub.shutdown()
+
+
+def test_unknown_verbs_surface_in_fleet_registry_snapshot():
+    """unknown_verbs rides drop_stats() into the FleetRegistry but is
+    reported as its own metric, NOT folded into conn_drops."""
+    from handyrl_tpu.resilience import FleetRegistry
+
+    reg = FleetRegistry(heartbeat_timeout=30.0, clock=lambda: 0.0)
+    reg.record_drops({"send_drops": 2, "disconnects": 1,
+                      "unknown_verbs": 7})
+    snap = reg.snapshot(now=0.0)
+    assert snap["unknown_verbs"] == 7
+    assert snap["conn_drops"] == 3

@@ -55,15 +55,42 @@ def test_docs_exist():
 
 
 def test_static_analysis_doc_covers_every_rule():
-    """docs/static_analysis.md documents each lint rule by id — BOTH
-    registries (the suppression comments reference these names, so the
-    page is the rule registries' public contract)."""
+    """docs/static_analysis.md documents each lint rule by id — ALL
+    THREE registries (the suppression comments reference these names,
+    so the page is the rule registries' public contract).  Mechanical,
+    like the parameters check above: a new rule set cannot land
+    undocumented."""
+    from handyrl_tpu.analysis.commrules import COMM_RULES
     from handyrl_tpu.analysis.rules import RULES
     from handyrl_tpu.analysis.shardrules import SHARD_RULES
 
     path = os.path.join(os.path.dirname(DOCS), "static_analysis.md")
     with open(path) as f:
         text = f.read()
-    missing = [r for r in list(RULES) + list(SHARD_RULES)
+    missing = [r
+               for r in (list(RULES) + list(SHARD_RULES)
+                         + list(COMM_RULES))
                if f"`{r}`" not in text]
     assert not missing, f"rules undocumented in static_analysis.md: {missing}"
+
+
+def test_list_rules_covers_every_registry():
+    """`handyrl-jaxlint --list-rules` prints every registered rule of
+    every family with its one-line doc, without needing the family
+    flags — the CLI's discoverability contract."""
+    import contextlib
+    import io
+
+    from handyrl_tpu.analysis.commrules import COMM_RULES
+    from handyrl_tpu.analysis.jaxlint import main
+    from handyrl_tpu.analysis.rules import RULES
+    from handyrl_tpu.analysis.shardrules import SHARD_RULES
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        assert main(["--list-rules"]) == 0
+    out = buf.getvalue()
+    for registry in (RULES, SHARD_RULES, COMM_RULES):
+        for rule_id, rule in registry.items():
+            assert f"{rule_id}: {rule.summary}" in out, (
+                f"--list-rules missing {rule_id} (or its summary)")

@@ -1,0 +1,75 @@
+"""Network-battle RPC round trip: the dynamic twin of commlint's
+static protocol graph.
+
+A real NetworkAgentClient (agent + mirror env) runs against the
+server-side NetworkAgent stub over an in-process duplex pipe, and the
+test drives every verb of the evaluation protocol — ``update`` /
+``observe`` / ``action`` / ``outcome`` / ``quit`` — asserting each
+request gets its matching reply (and that ``quit``, fire-and-forget by
+protocol, terminates the client loop without one).  What commlint
+proves from source (every sent verb has a handler, every round-trip
+handler replies), this proves by execution."""
+
+import threading
+from multiprocessing import Pipe
+
+from handyrl_tpu.agent import RandomAgent
+from handyrl_tpu.envs.tictactoe import Environment as TicTacToe
+from handyrl_tpu.evaluation import NetworkAgent, NetworkAgentClient
+
+
+def _start_client(conn):
+    client = NetworkAgentClient(RandomAgent(), TicTacToe(), conn)
+    thread = threading.Thread(target=client.run, daemon=True)
+    thread.start()
+    return thread
+
+
+def test_every_protocol_verb_round_trips():
+    server_conn, client_conn = Pipe(duplex=True)
+    thread = _start_client(client_conn)
+    agent = NetworkAgent(server_conn)
+    env = TicTacToe()
+    assert not env.reset()
+
+    # update(reset=True): client mirrors the fresh env, resets agent
+    assert agent.update(env.diff_info(0), True) is None
+
+    # a few real turns: action returns the client's action STRING,
+    # decodable and legal in the server's env
+    for _ in range(3):
+        player = env.turns()[0]
+        action_str = agent.action(player)
+        assert isinstance(action_str, str)
+        action = env.str2action(action_str, player)
+        assert action in env.legal_actions(player)
+        # the other seat merely observes this turn
+        other = [p for p in env.players() if p != player][0]
+        agent.observe(other)
+        assert not env.step({player: action})
+        # delta-sync the client's mirror (update(reset=False))
+        assert agent.update(env.diff_info(0), False) is None
+        if env.terminal():
+            break
+
+    # outcome: acked with an (empty) reply, not silence
+    assert agent.outcome(1) is None
+
+    # quit is fire-and-forget: no reply, and the client loop exits
+    agent.quit()
+    thread.join(timeout=10)
+    assert not thread.is_alive(), "client did not exit on quit"
+
+
+def test_quit_is_idempotent_on_dead_client():
+    """quit() after the client is gone must not raise — series teardown
+    races client exits by design."""
+    server_conn, client_conn = Pipe(duplex=True)
+    thread = _start_client(client_conn)
+    agent = NetworkAgent(server_conn)
+    agent.quit()
+    thread.join(timeout=10)
+    assert not thread.is_alive()
+    client_conn.close()
+    agent.quit()  # second quit into a closed pipe: swallowed
+    agent.quit()

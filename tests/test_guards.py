@@ -291,3 +291,79 @@ def test_sharding_guard_sums_over_wrapped_fns():
     b(jax.device_put(jnp.ones(2), devices[0]))
     a(jax.device_put(jnp.ones(2), devices[1]))
     assert guard.copies == 1
+
+
+# ---------------------------------------------------------------------
+# StallWatchdog
+# ---------------------------------------------------------------------
+
+def test_stall_watchdog_counts_and_recovers():
+    """A loop silent past the threshold is ONE stall event (not one per
+    sample); beating again recovers it, and a later silence counts as a
+    fresh episode."""
+    from handyrl_tpu.analysis.guards import StallWatchdog
+
+    t = [0.0]
+    dog = StallWatchdog(max_stall_seconds=5.0, clock=lambda: t[0])
+    dog.beat("server")
+    t[0] = 3.0
+    assert dog.sample() == 0          # within threshold
+    t[0] = 6.0
+    assert dog.sample() == 1          # newly stalled
+    t[0] = 9.0
+    assert dog.sample() == 0          # same episode: counted once
+    dog.beat("server")                # recovery
+    t[0] = 20.0
+    assert dog.sample() == 1          # second episode
+    assert dog.stall_events == 2
+
+
+def test_stall_watchdog_snapshot_is_a_delta():
+    from handyrl_tpu.analysis.guards import StallWatchdog
+
+    t = [0.0]
+    dog = StallWatchdog(max_stall_seconds=1.0, clock=lambda: t[0])
+    dog.beat("send_loop")
+    t[0] = 5.0
+    dog.sample()
+    assert dog.snapshot() == 1
+    assert dog.snapshot() == 0        # per-epoch delta semantics
+
+
+def test_stall_watchdog_tracks_loops_independently():
+    from handyrl_tpu.analysis.guards import StallWatchdog
+
+    t = [0.0]
+    dog = StallWatchdog(max_stall_seconds=2.0, clock=lambda: t[0])
+    dog.beat("server")
+    dog.beat("recv_loop")
+    t[0] = 1.5
+    dog.beat("recv_loop")             # only the server goes silent
+    t[0] = 3.0
+    assert dog.sample() == 1
+    assert dog.stall_events == 1
+
+
+def test_stall_watchdog_dumps_the_stalled_stack(capsys):
+    from handyrl_tpu.analysis.guards import StallWatchdog
+
+    t = [0.0]
+    dog = StallWatchdog(max_stall_seconds=1.0, clock=lambda: t[0])
+    dog.beat("server")
+    t[0] = 10.0
+    dog.sample()
+    out = capsys.readouterr().out
+    assert "control-plane loop 'server' silent" in out
+    assert "File " in out             # a real stack dump, not a shrug
+
+
+def test_stall_watchdog_start_stop_idempotent():
+    from handyrl_tpu.analysis.guards import StallWatchdog
+
+    dog = StallWatchdog(max_stall_seconds=60.0)
+    dog.start()
+    dog.start()                       # second start is a no-op
+    dog.beat("server")
+    dog.stop()
+    dog.stop()                        # second stop is a no-op
+    assert dog.stall_events == 0

@@ -4,13 +4,15 @@ gate itself (the whole package must lint clean).
 
 Fixture convention (tests/fixtures/jaxlint/): ``<rule>_pos.py`` must
 produce findings of exactly that rule, ``<rule>_neg.py`` and
-``<rule>_supp.py`` must produce none.  The fixtures are parsed, never
+``<rule>_supp.py`` must produce none (driver shared with the shard/
+comm suites: tests/lintfix.py).  The fixtures are parsed, never
 imported."""
 
 import json
 import os
 
 import pytest
+from lintfix import check_fixture, fixture_path
 
 from handyrl_tpu.analysis.jaxlint import lint_paths, lint_source, main
 from handyrl_tpu.analysis.rules import RULES
@@ -23,34 +25,13 @@ RULE_IDS = sorted(RULES)
 
 
 def fixture(rule_id, kind):
-    path = os.path.join(FIXTURES, f"{rule_id.replace('-', '_')}_{kind}.py")
-    assert os.path.exists(path), f"missing fixture {path}"
-    return path
+    return fixture_path("jaxlint", rule_id, kind)
 
 
+@pytest.mark.parametrize("kind", ["pos", "neg", "supp"])
 @pytest.mark.parametrize("rule_id", RULE_IDS)
-def test_rule_fires_on_positive_fixture(rule_id):
-    findings = lint_paths([fixture(rule_id, "pos")])
-    assert findings, f"{rule_id} produced no findings on its positive"
-    assert all(f.rule == rule_id for f in findings), (
-        f"cross-rule noise on {rule_id}_pos: "
-        f"{[(f.rule, f.line) for f in findings]}")
-
-
-@pytest.mark.parametrize("rule_id", RULE_IDS)
-def test_rule_quiet_on_negative_fixture(rule_id):
-    findings = lint_paths([fixture(rule_id, "neg")])
-    assert findings == [], (
-        f"false positives on {rule_id}_neg: "
-        f"{[(f.rule, f.line, f.message) for f in findings]}")
-
-
-@pytest.mark.parametrize("rule_id", RULE_IDS)
-def test_rule_suppressed_with_reason(rule_id):
-    findings = lint_paths([fixture(rule_id, "supp")])
-    assert findings == [], (
-        f"suppression not honored on {rule_id}_supp: "
-        f"{[(f.rule, f.line) for f in findings]}")
+def test_rule_fixture(rule_id, kind):
+    check_fixture("jaxlint", rule_id, kind)
 
 
 def test_every_positive_names_real_rules():

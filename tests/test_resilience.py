@@ -673,7 +673,8 @@ def test_queue_communicator_counts_send_drops():
     deadline = time.monotonic() + 5.0
     while comm.send_drops < 2 and time.monotonic() < deadline:
         time.sleep(0.02)
-    assert comm.drop_stats() == {"send_drops": 2, "disconnects": 1}
+    assert comm.drop_stats() == {"send_drops": 2, "disconnects": 1,
+                                 "unknown_verbs": 0}
     comm.shutdown()
 
 

@@ -51,6 +51,12 @@ def test_local_training_two_epochs(tmp_path, monkeypatch):
             "host_transfer_guard": True,
             "sharding_contract_guard": True,
             "max_resharding_copies": 1,
+            # control-plane stall watchdog armed for real: the server
+            # loop and communicator threads must beat throughout, so a
+            # wedge introduced by a future protocol change shows up as
+            # stall_events > 0 here
+            "stall_watchdog": True,
+            "max_stall_seconds": 30.0,
             "metrics_path": "metrics.jsonl",
         },
         "worker_args": {"num_parallel": 2, "server_address": ""},
@@ -88,6 +94,10 @@ def test_local_training_two_epochs(tmp_path, monkeypatch):
         assert record["retrace_count"] == 1
         assert record["host_transfers"] >= 1  # the epoch snapshot sync
         assert record["resharding_copies"] == 0
+        # every control-plane wait stayed bounded (no wedged loop) and
+        # no peer spoke a verb the server does not handle
+        assert record["stall_events"] == 0
+        assert record["unknown_verbs"] == 0
 
     assert os.path.exists("models/1.ckpt")
     assert os.path.exists("models/2.ckpt")
