@@ -76,6 +76,13 @@ FORK_CALLS = frozenset({"os.fork", "os.forkpty"})
 GET_CONTEXT_NAMES = frozenset({
     "multiprocessing.get_context", "multiprocessing.context.get_context",
 })
+# telemetry's trace-context envelope codec (telemetry.spans): these
+# functions are TRANSPARENT to the protocol — ``wrap_trace(msg)`` IS
+# ``msg`` for verb collection and ``unwrap_trace(conn.recv())`` is a
+# recv, while the envelope head they add/strip is a wire detail, never
+# a verb.  Matched by trailing name so both the package definitions and
+# re-imports resolve.
+TRACE_CODECS = frozenset({"wrap_trace", "unwrap_trace"})
 
 
 def _const_str(node) -> Optional[str]:
@@ -135,6 +142,30 @@ def _is_send_attr_call(call: ast.Call) -> Optional[ast.expr]:
 def _is_recv_attr_call(call: ast.Call) -> bool:
     return (isinstance(call.func, ast.Attribute)
             and call.func.attr == "recv")
+
+
+def _codec_name(pkg: Package, mod: ModuleInfo, scope,
+                func) -> Optional[str]:
+    """Trailing name of a call target when it resolves at all (package
+    function or external dotted name); None for computed targets."""
+    res = pkg.resolve_callee(mod, scope, func)
+    if res is None:
+        return None
+    name = res[1].qname if res[0] == "fn" else (res[1] or "")
+    # qnames read "module:Class.method"; externals read "pkg.mod.fn"
+    return name.rpartition(".")[2].rpartition(":")[2]
+
+
+def _strip_trace_codec(pkg: Package, mod: ModuleInfo, scope, expr):
+    """Look through the trace-context envelope codec: without this, a
+    send moved behind ``wrap_trace`` would silently vanish from the
+    protocol graph — and a vanished verb disables unhandled-verb /
+    dead-handler / reply-mismatch for that part of the plane."""
+    while isinstance(expr, ast.Call) and len(expr.args) == 1 \
+            and not expr.keywords \
+            and _codec_name(pkg, mod, scope, expr.func) in TRACE_CODECS:
+        expr = expr.args[0]
+    return expr
 
 
 def _fn_nodes(fn: FunctionInfo):
@@ -319,6 +350,8 @@ class CommAnalysis:
                 continue
             payload = _is_send_attr_call(node)
             if payload is not None:
+                payload = _strip_trace_codec(
+                    self.pkg, fn.module, fn, payload)
                 sm.does_send = True
                 if isinstance(payload, ast.Name) \
                         and payload.id in params:
@@ -540,6 +573,7 @@ class CommAnalysis:
             payloads: List[Tuple[ast.expr, bool]] = []
             direct = _is_send_attr_call(node)
             if direct is not None:
+                direct = _strip_trace_codec(self.pkg, mod, fn, direct)
                 base = dotted_parts(node.func.value)
                 expects = bool(base) and tuple(base) in recv_bases
                 payloads.append((direct, expects))
@@ -576,6 +610,10 @@ class CommAnalysis:
         out: Set[str] = set()
 
         def recv_like(value) -> bool:
+            if not isinstance(value, ast.Call):
+                return False
+            # unwrap_trace(conn.recv()) is a recv for binding purposes
+            value = _strip_trace_codec(self.pkg, fn.module, fn, value)
             if not isinstance(value, ast.Call):
                 return False
             if isinstance(value.func, ast.Attribute) \

@@ -344,6 +344,11 @@ class StallWatchdog:
         self._lock = threading.Lock()
         self._thread = None
         self._stop = threading.Event()
+        # called once per NEWLY stalled loop with (name, silent_sec):
+        # the learner wires the telemetry flight-recorder dump here, so
+        # a stall leaves its causal timeline behind, not just a stack.
+        # Injected rather than imported: analysis stays standalone
+        self.on_stall = None
 
     # -- liveness intake --------------------------------------------
     def beat(self, loop: str = "server"):
@@ -372,8 +377,14 @@ class StallWatchdog:
                 state[1] = True
                 self.stall_events += 1
                 newly.append((name, now - state[0], state[2]))
+        hook = self.on_stall
         for name, silent, ident in newly:
             self._dump(name, silent, ident)
+            if hook is not None:
+                try:
+                    hook(name, silent)
+                except Exception as exc:  # a dead hook must not kill
+                    print(f"WARNING: on_stall hook failed ({exc!r})")
         return len(newly)
 
     def _dump(self, name, silent, ident):

@@ -170,6 +170,23 @@ class TrainConfig:
     # boundary beats through trainer.update(), so ordinary long epochs
     # do not count)
     max_stall_seconds: float = 60.0
+    # -- telemetry (handyrl_tpu.telemetry) --
+    # arm span tracing + the flight recorder: trace_span sections,
+    # trace-context propagation over the control plane, per-process
+    # span logs next to metrics_path, and flightrec.json dumps on
+    # stall/crash/SIGTERM.  Off = every telemetry entry point is a
+    # constant-time no-op and the wire format carries no envelopes
+    telemetry: bool = True
+    # fraction of episodes that carry a propagated trace context
+    # (per-episode sampling decision at generation); spans for
+    # unsampled episodes still record locally without a context
+    trace_sample_rate: float = 1.0
+    # flight-recorder ring capacity: the last N spans/events kept for
+    # the post-mortem dump
+    flightrec_spans: int = 2048
+    # read-only learner status endpoint (live JSON over HTTP for
+    # dashboards); 0 = off
+    status_port: int = 0
     # chaos fault injection for resilience tests (keys: kill_prob,
     # kill_after, max_kills, frame_drop_prob, frame_truncate_prob,
     # frame_delay_prob, frame_delay, seed); empty = off
@@ -207,9 +224,13 @@ class TrainConfig:
                     "device_replay_episodes", "updates_per_epoch",
                     "max_update_compiles", "max_resharding_copies",
                     "heartbeat_interval", "max_respawns",
-                    "max_frame_bytes"):
+                    "max_frame_bytes", "status_port"):
             if getattr(self, key) < 0:
                 raise ValueError(f"{key} must be >= 0")
+        if not 0.0 <= self.trace_sample_rate <= 1.0:
+            raise ValueError("trace_sample_rate must be in [0, 1]")
+        if self.flightrec_spans < 1:
+            raise ValueError("flightrec_spans must be >= 1")
         if self.respawn_backoff <= 0:
             raise ValueError("respawn_backoff must be > 0")
         if self.max_stall_seconds <= 0:

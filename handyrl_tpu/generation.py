@@ -30,6 +30,7 @@ import pickle
 
 import numpy as np
 
+from . import telemetry
 from .agent import ILLEGAL, RandomAgent, sample_action
 
 MOMENT_KEYS = (
@@ -189,7 +190,8 @@ class _Slot:
     """One in-flight job inside the pool."""
 
     __slots__ = ("job", "mode", "moments", "trained", "agents",
-                 "opponent", "on_turn", "parts", "pending", "model")
+                 "opponent", "on_turn", "parts", "pending", "model",
+                 "trace", "t0")
 
     def __init__(self, job, mode):
         self.job = job
@@ -202,6 +204,8 @@ class _Slot:
         self.parts = ()
         self.pending = {}           # player -> obs staged this step
         self.model = None           # eval: the snapshot this match uses
+        self.trace = telemetry.maybe_trace()  # sampled episode context
+        self.t0 = telemetry.span_begin()      # rollout span start
 
 
 class RolloutPool:
@@ -390,6 +394,7 @@ class RolloutPool:
         self.slots[k] = None
         self._free.append(k)
         env = self.envs[k]
+        self._close_span(slot)
         if slot.mode == "g":
             if not payload_ok or not slot.moments:
                 print("None episode in generation!")
@@ -406,12 +411,29 @@ class RolloutPool:
             # it.  Consumers fall back to the job label when absent
             # (sequential Generator episodes are single-policy).
             episode["final_model_epoch"] = self.model_epoch
+            # telemetry stamps: the learner reduces gen_model_epoch
+            # into the per-epoch policy_lag_* metrics, and the trace
+            # context lets the exported trace follow this episode
+            # worker -> gather -> learner across processes
+            episode["gen_model_epoch"] = self.model_epoch
+            if slot.trace is not None:
+                episode["trace"] = slot.trace
             return ("episode", episode)
         if not payload_ok:
             print("None episode in evaluation!")
             return ("result", None)
-        return ("result", {"args": slot.job, "result": env.outcome(),
-                           "opponent": slot.opponent})
+        result = {"args": slot.job, "result": env.outcome(),
+                  "opponent": slot.opponent}
+        if slot.trace is not None:
+            result["trace"] = slot.trace
+        return ("result", result)
+
+    def _close_span(self, slot):
+        """Record the slot's rollout span under its own context."""
+        telemetry.set_trace(slot.trace)
+        telemetry.span_end("episode.rollout", slot.t0, mode=slot.mode,
+                           steps=len(slot.moments))
+        telemetry.clear_trace()
 
     def _advance_generation(self, k, slot, outputs):
         env = self.envs[k]

@@ -42,6 +42,7 @@ try:
 except ImportError:  # pragma: no cover
     psutil = None
 
+from . import telemetry
 from .analysis.guards import (
     HostTransferGuard,
     RetraceGuard,
@@ -99,12 +100,16 @@ def _batch_worker(conn, bid, cfg):
     from .batch import set_columnar_cache_mb
 
     set_columnar_cache_mb(cfg.get("columnar_cache_mb"))
+    telemetry.configure_from_args(cfg, role=f"batcher-{bid}",
+                                  primary=False)
     print(f"started batcher {bid}")
     try:
         while True:
             # jaxlint: disable=unbounded-recv -- batcher child on a parent pipe: learner death breaks the pipe and the except below exits the process
             episodes = conn.recv()
-            batch = make_batch(episodes, cfg)
+            with telemetry.trace_span("batch.make",
+                                      episodes=len(episodes)):
+                batch = make_batch(episodes, cfg)
             conn.send(batch)
     except (ConnectionResetError, BrokenPipeError, EOFError, OSError):
         pass  # learner is gone: exit quietly
@@ -124,10 +129,13 @@ class Batcher:
         # the global batch (batch_size = global / process_count)
         self.batch_size = batch_size or args["batch_size"]
         # children only need the batch-geometry keys, not the env
+        # (plus the telemetry keys, so batch.make spans land in the
+        # same run's span log)
         cfg = {k: args[k] for k in (
             "turn_based_training", "observation", "forward_steps",
             "burn_in_steps", "compress_steps", "lambda",
-            "columnar_cache_mb",
+            "columnar_cache_mb", "telemetry", "trace_sample_rate",
+            "flightrec_spans", "metrics_path",
         ) if k in args}
         transfer = resolve_transfer_dtype(args)
         if transfer:
@@ -921,6 +929,16 @@ class Trainer:
         self.last_metrics = {k: l / data_cnt for k, l in loss_sum.items()}
         for name, v in prof.items():
             self.last_metrics[f"profile_{name}_sec"] = v["sec"]
+        # pipeline telemetry, canonical keys (docs/observability.md):
+        # seconds the hot loop starved for its feed, seconds inside the
+        # device step dispatch, and the feed backlog at the epoch
+        # boundary.  Always present — the device-replay path simply has
+        # no batch wait (its draw rides the fused step)
+        self.last_metrics["batch_wait_sec"] = \
+            prof.get("batch_wait", {}).get("sec", 0.0)
+        self.last_metrics["device_step_sec"] = \
+            prof.get("update", {}).get("sec", 0.0)
+        self.last_metrics["queue_depth"] = self._queue_depth()
         # guard counters (see analysis.guards): the compile count is
         # cumulative and must stay flat after the first epoch; host
         # transfers are the per-epoch delta and must not grow with
@@ -948,6 +966,21 @@ class Trainer:
             except OSError:
                 pass
         return snapshot
+
+    def _queue_depth(self):
+        """Feed backlog at the epoch boundary: device-staged batches +
+        assembled host batches waiting (host path), or episodes queued
+        for ring ingest (device replay).  A depth pinned at 0 alongside
+        a large `batch_wait_sec` says the FEED is the bottleneck; a
+        full queue with near-zero wait says the device is."""
+        depth = 0
+        if self.prefetcher is not None:
+            depth += self.prefetcher.staged.qsize()
+        if self.batcher is not None:
+            depth += self.batcher.executor.output_queue.qsize()
+        if self.device_replay is not None:
+            depth += len(self.device_replay.pending)
+        return depth
 
     def request_shutdown(self):
         """Ask the training thread to stop (checked between batches and
@@ -1033,6 +1066,13 @@ class Trainer:
 
             traceback.print_exc()
             self.failure = exc
+            # the flight recorder's crash trigger, strictly AFTER the
+            # failure is recorded: a dump that itself dies must not
+            # leave Learner.update() waiting forever on this thread
+            try:
+                telemetry.crash_dump("trainer", exc)
+            except Exception:
+                pass
         finally:
             if self.transfer_guard is not None:
                 self.transfer_guard.__exit__(None, None, None)
@@ -1115,6 +1155,17 @@ class Learner:
         self.args = train_args
         random.seed(self.args["seed"])
 
+        # telemetry first: spans recorded by anything constructed below
+        # (trainer warmup, worker bring-up) land in this run's log
+        telemetry.configure_from_args(
+            self.args, role="learner",
+            primary=jax.process_index() == 0)
+        telemetry.install_signal_dump()
+        self._run_t0 = time.monotonic()
+        self._epoch_t = self._run_t0
+        self._policy_lags = []        # episode lags consumed this epoch
+        self._last_record = None      # latest metrics record (status)
+
         self.env = make_env(env_args)
         # guarantee at least ~update_episodes^0.85 eval games per epoch
         # (single source of truth: TrainConfig.effective_eval_rate)
@@ -1166,7 +1217,33 @@ class Learner:
             # the epoch boundary waits inside trainer.update(); beating
             # there keeps a LONG epoch distinct from a wedged server
             self.trainer.stall_beat = self.stall_watchdog.beat
+            # a stall is the flight recorder's marquee trigger: the
+            # ring turns the watchdog's stack dump into the causal
+            # timeline of the 30s before the wedge
+            self.stall_watchdog.on_stall = telemetry.stall_hook
             self.stall_watchdog.start()
+        # read-only live status endpoint (dashboards poll this instead
+        # of touching the control plane); 0 = off
+        self.status = None
+        status_port = int(self.args.get("status_port", 0) or 0)
+        if status_port and self.primary:
+            from .telemetry.status import StatusServer
+
+            self.status = StatusServer(status_port,
+                                       self._status_snapshot)
+
+    def _status_snapshot(self):
+        """Live JSON for the status endpoint: fleet + telemetry + the
+        latest per-epoch metrics record.  Read-only by construction."""
+        return {
+            "epoch": self.model_epoch,
+            "episodes_received": self.episodes_received,
+            "connections": self.worker.connection_count(),
+            "time_sec": round(time.monotonic() - self._run_t0, 3),
+            "fleet": self.fleet.snapshot(),
+            "telemetry": telemetry.stats(),
+            "last_record": self._last_record,
+        }
 
     def _initial_model(self, net):
         if net is not None:
@@ -1221,9 +1298,34 @@ class Learner:
         self._prune_checkpoints()
 
     # -- episode / result intake ------------------------------------
+    def _note_intake(self, episode):
+        """Per-episode telemetry at intake: the policy-version lag
+        (learner epoch now vs the snapshot that generated the episode
+        — the off-policy staleness signal reduced into `policy_lag_*`
+        per epoch) and, for trace-stamped episodes, an intake event
+        under the episode's own context so the exported trace crosses
+        the worker -> learner process boundary."""
+        gen = episode.get("gen_model_epoch")
+        if gen is None:
+            # pre-stamp episode (or a replayed fixture): fall back to
+            # the scheduled trained-seat label
+            job = episode["args"]
+            labels = [job["model_id"][p] for p in job["player"]]
+            gen = max([l for l in labels if l >= 0],
+                      default=self.model_epoch)
+        self._policy_lags.append(max(0, self.model_epoch - gen))
+        ctx = episode.get("trace")
+        if ctx is not None and telemetry.enabled():
+            prev = telemetry.current_trace()
+            telemetry.set_trace(ctx)
+            telemetry.add_event("episode.intake", lag=int(
+                max(0, self.model_epoch - gen)))
+            telemetry.set_trace(prev)  # the rpc span keeps ITS context
+
     def feed_episodes(self, episodes):
         kept = [e for e in episodes if e is not None]
         for episode in kept:
+            self._note_intake(episode)
             job = episode["args"]
             # trained seats credit the epoch that actually finished the
             # episode (the pool may swap snapshots mid-flight; see
@@ -1331,7 +1433,17 @@ class Learner:
     def update(self):
         print()
         print("epoch %d" % self.model_epoch)
+        # NOTE the epoch field is stamped at epoch START (before
+        # update_model increments it), so a run's records read
+        # [restart_epoch, restart_epoch+1, ...] — docs/observability.md
         record = {"epoch": self.model_epoch}
+        now = time.monotonic()
+        record["time_sec"] = round(now - self._run_t0, 3)
+        record["epoch_wall_sec"] = round(now - self._epoch_t, 3)
+        self._epoch_t = now
+        # off-policy staleness over the episodes consumed this epoch
+        record.update(telemetry.summarize_lags(self._policy_lags))
+        self._policy_lags = []
         self._report_win_rates(record)
         self._report_generation(record)
 
@@ -1357,6 +1469,8 @@ class Learner:
         if self.metrics_path and self.primary:
             with open(self.metrics_path, "a") as f:
                 f.write(json.dumps(record) + "\n")
+        self._last_record = record     # status endpoint reads this
+        telemetry.flush()              # epoch boundary: spans to disk
         self.replay.warned = False
 
     # -- fleet health -----------------------------------------------
@@ -1497,7 +1611,12 @@ class Learner:
                     self.worker.note_unknown_verb(verb)
                     self.worker.send(conn, [] if batched else None)
                     continue
-                replies = handler(payload if batched else [payload])
+                # the request's trace context (adopted by the
+                # communicator's recv codec) is current here, so this
+                # span joins the sending worker's trace — the learner
+                # side of the cross-process timeline
+                with telemetry.trace_span("rpc." + str(verb)):
+                    replies = handler(payload if batched else [payload])
                 self.worker.send(
                     conn, replies if batched else replies[0])
 
@@ -1603,6 +1722,9 @@ class Learner:
                 # after shutdown the loops stop beating by design; a
                 # late sample must not report teardown as a stall
                 self.stall_watchdog.stop()
+            if self.status is not None:
+                self.status.close()
+            telemetry.flush()  # ship the span-log tail before exit
 
 
 def _maybe_init_distributed(args):

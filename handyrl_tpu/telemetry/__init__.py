@@ -1,0 +1,46 @@
+"""handyrl_tpu.telemetry — distributed tracing, flight recorder, status.
+
+Public surface (see :mod:`.spans` for the design notes):
+
+  * spans: ``trace_span`` / ``record_span`` / ``add_event`` /
+    ``span_begin`` / ``span_end``, configured per process via
+    ``configure_from_args`` (the same args dict every child receives);
+  * trace context: ``new_trace`` / ``maybe_trace`` / ``current_trace``
+    / ``set_trace`` / ``clear_trace`` and the wire envelope
+    ``wrap_trace`` / ``unwrap_trace`` (ridden by
+    ``connection.TracedConnection`` and the ``QueueCommunicator``);
+  * flight recorder: ``dump`` / ``dump_count`` / ``stall_hook`` /
+    ``crash_dump`` / ``install_signal_dump``;
+  * exporters: :mod:`.export` (Perfetto ``trace.json``) and
+    :mod:`.status` (read-only HTTP snapshot);
+  * metrics: ``summarize_lags`` (the per-epoch policy-version-lag
+    reduction).
+"""
+
+from .spans import (  # noqa: F401
+    TRACE_HEAD,
+    add_event,
+    clear_trace,
+    configure,
+    configure_from_args,
+    crash_dump,
+    current_trace,
+    dump,
+    dump_count,
+    enabled,
+    flush,
+    install_signal_dump,
+    maybe_trace,
+    new_trace,
+    payload_trace,
+    record_span,
+    set_trace,
+    span_begin,
+    span_end,
+    stall_hook,
+    stats,
+    summarize_lags,
+    trace_span,
+    unwrap_trace,
+    wrap_trace,
+)

@@ -1,0 +1,55 @@
+"""Read-only learner status endpoint: live JSON over HTTP.
+
+``status_port: <port>`` arms one on the learner; ``curl
+http://learner:<port>/`` returns the latest fleet + telemetry + epoch
+snapshot — the poll target for dashboards that must not touch the
+control plane (the worker protocol stays workers-only; this socket
+cannot mutate anything: every method but GET is rejected).
+
+Runs a ThreadingHTTPServer on a daemon thread; the snapshot callable is
+invoked per request on the server thread, so it must only read
+(`Learner._status_snapshot` assembles from already-thread-safe
+sources: the FleetRegistry lock, the last metrics record, telemetry
+counters).
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class StatusServer:
+    """Serve ``snapshot_fn()`` as JSON on every GET."""
+
+    def __init__(self, port, snapshot_fn):
+        self.snapshot_fn = snapshot_fn
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                try:
+                    body = json.dumps(outer.snapshot_fn()).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                except Exception as exc:  # snapshot raced a teardown
+                    body = json.dumps({"error": repr(exc)}).encode()
+                    self.send_response(500)
+                    self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):  # quiet by default
+                pass
+
+        self.server = ThreadingHTTPServer(("", int(port)), Handler)
+        self.port = self.server.server_address[1]
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True)
+        self.thread.start()
+        print(f"status endpoint on :{self.port}")
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+        self.thread.join(timeout=5)

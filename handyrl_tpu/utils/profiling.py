@@ -25,23 +25,34 @@ from ..analysis.guards import (  # noqa: F401  (observability surface)
     HostTransferGuard,
     RetraceGuard,
 )
+from ..telemetry import spans as _telemetry
 
 
 class SectionTimers:
-    """Accumulate wall time per named section between snapshots."""
+    """Accumulate wall time per named section between snapshots.
 
-    def __init__(self):
+    Each timed section ALSO records a telemetry span (``trainer.<name>``
+    against the telemetry clock) when telemetry is armed, so the
+    trainer's ingest/batch_wait/update sections appear on the exported
+    Perfetto timeline without a second set of instrumentation sites."""
+
+    def __init__(self, span_prefix="trainer."):
         self.totals = defaultdict(float)
         self.counts = defaultdict(int)
+        self.span_prefix = span_prefix
 
     @contextmanager
     def section(self, name):
         t0 = time.perf_counter()
+        tel = _telemetry.enabled()
+        st0 = _telemetry.span_begin() if tel else 0.0
         try:
             yield
         finally:
             self.totals[name] += time.perf_counter() - t0
             self.counts[name] += 1
+            if tel:
+                _telemetry.span_end(self.span_prefix + name, st0)
 
     def snapshot(self, reset=True):
         """{name: {"sec": total, "n": count}}, optionally resetting."""

@@ -36,6 +36,7 @@ from socket import gethostname
 
 from .connection import (
     QueueCommunicator,
+    TracedConnection,
     _mp,
     accept_socket_connections,
     force_cpu_jax,
@@ -43,6 +44,8 @@ from .connection import (
     open_socket_connection,
     send_recv,
 )
+from . import telemetry
+from .telemetry import payload_trace
 
 ENTRY_PORT = 9999
 WORKER_PORT = 9998
@@ -170,7 +173,35 @@ class Worker:
     def _run_job(self, job):
         models = self._resolve(job)
         runner, reply_verb = self.roles[job["role"]]
-        send_recv(self.conn, (reply_verb, runner(models, job)))
+        payload = self._traced_run(runner, job, models)
+        with payload_trace(payload):
+            send_recv(self.conn, (reply_verb, payload))
+
+    @staticmethod
+    def _traced_run(runner, job, models):
+        """One sequential job under a fresh (sampled) trace context:
+        the rollout span is recorded here, and the finished payload is
+        stamped with its context plus the snapshot epoch that generated
+        it — the learner reduces those stamps into the per-epoch
+        `policy_lag_*` metrics and follows the context across
+        processes in the exported trace."""
+        ctx = telemetry.maybe_trace()
+        telemetry.set_trace(ctx)
+        t0 = telemetry.span_begin()
+        try:
+            payload = runner(models, job)
+            telemetry.span_end("episode.rollout", t0,
+                               mode=job["role"])
+        finally:
+            telemetry.clear_trace()
+        if isinstance(payload, dict):
+            if ctx is not None:
+                payload.setdefault("trace", ctx)
+            labels = [job["model_id"][p] for p in job["player"]]
+            gen = max([l for l in labels if l >= 0], default=-1)
+            if gen >= 0:
+                payload.setdefault("gen_model_epoch", gen)
+        return payload
 
     def _run_lockstep(self):
         pool = self.pool
@@ -187,9 +218,11 @@ class Worker:
                     self._run_job(job)
                     continue
                 for verb, payload in pool.assign(job, self._resolve(job)):
-                    send_recv(self.conn, (verb, payload))
+                    with payload_trace(payload):
+                        send_recv(self.conn, (verb, payload))
             for verb, payload in pool.step():
-                send_recv(self.conn, (verb, payload))
+                with payload_trace(payload):
+                    send_recv(self.conn, (verb, payload))
 
     def _drain_pool(self):
         """Step the pool without assigning new jobs until every
@@ -197,7 +230,8 @@ class Worker:
         pool = self.pool
         while any(slot is not None for slot in pool.slots):
             for verb, payload in pool.step():
-                send_recv(self.conn, (verb, payload))
+                with payload_trace(payload):
+                    send_recv(self.conn, (verb, payload))
 
     def run(self):
         try:
@@ -211,11 +245,17 @@ class Worker:
                 self._run_job(job)
         except _PEER_GONE:
             pass  # learner/gather went away: exit quietly
+        finally:
+            telemetry.flush()  # ship the span-log tail before exit
 
 
 def _spawn_worker(conn, args, wid):
     force_cpu_jax()
-    Worker(args, conn, wid).run()
+    telemetry.configure_from_args(args, role=f"worker-{wid}",
+                                  primary=False)
+    # the codec wraps post-spawn, in the owning process: sends carry
+    # this worker's episode contexts, recvs adopt the gather's
+    Worker(args, TracedConnection(conn), wid).run()
 
 
 class Gather(QueueCommunicator):
@@ -362,7 +402,16 @@ def _maybe_chaos_wrap(conn, args, gather_id):
 
 def gather_loop(args, conn, gather_id):
     force_cpu_jax()
-    gather = Gather(args, _maybe_chaos_wrap(conn, args, gather_id),
+    telemetry.configure_from_args(args, role=f"gather-{gather_id}",
+                                  primary=False)
+    # a chaos kill (or any preemption) is a SIGTERM: leave the flight
+    # record behind on the way out
+    telemetry.install_signal_dump()
+    # trace codec OUTSIDE the chaos wrapper, so injected frame faults
+    # hit enveloped frames exactly like real traffic
+    gather = Gather(args,
+                    TracedConnection(
+                        _maybe_chaos_wrap(conn, args, gather_id)),
                     gather_id)
     try:
         gather.run()
@@ -371,6 +420,8 @@ def gather_loop(args, conn, gather_id):
         # supervising RemoteWorkerCluster counts a failure — only the
         # drain path (workers done, run() returns) exits 0
         raise SystemExit(1)
+    finally:
+        telemetry.flush()  # ship the span-log tail before exit
 
 
 def _default_num_gathers(num_parallel):

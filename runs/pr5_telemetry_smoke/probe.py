@@ -1,0 +1,54 @@
+"""Induced-stall probe: one wedged control-plane loop must produce
+exactly ONE flight-recorder dump (flightrec.json) with the causal
+timeline — the live twin of tests/test_telemetry.py's injectable-clock
+version, run against the real clock and the real sampler thread.
+
+    cd runs/pr5_telemetry_smoke && python probe.py
+
+The probe arms telemetry + a StallWatchdog exactly the way the learner
+does (`on_stall = telemetry.stall_hook`), records a few spans of
+"work", beats the server loop, then goes silent past
+max_stall_seconds.  The watchdog's sampler notices, dumps the ring,
+and the probe asserts: one stall event, one dump, the pre-stall spans
+present in the file.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from handyrl_tpu import telemetry                      # noqa: E402
+from handyrl_tpu.analysis.guards import StallWatchdog  # noqa: E402
+
+
+def main():
+    telemetry.configure(enabled=True, ring=256, log_dir=".",
+                        role="probe", primary=True)
+    dog = StallWatchdog(max_stall_seconds=2.0)
+    dog.on_stall = telemetry.stall_hook
+    dog.start()
+    # a healthy phase: spans recorded, the loop beating
+    for i in range(5):
+        with telemetry.trace_span("probe.work", i=i):
+            time.sleep(0.1)
+        dog.beat("server")
+    print("going silent (wedging the 'server' loop)...")
+    time.sleep(4.0)  # > max_stall_seconds: the sampler fires
+    dog.stop()
+    assert dog.stall_events == 1, dog.stall_events
+    assert telemetry.dump_count() == 1, telemetry.dump_count()
+    with open("flightrec.json") as f:
+        doc = json.load(f)
+    names = [s["name"] for s in doc["spans"]]
+    assert doc["reason"] == "stall_event"
+    assert names.count("stall") == 1
+    assert "probe.work" in names  # the timeline BEFORE the wedge
+    print(f"OK: exactly one dump, reason={doc['reason']}, "
+          f"{len(names)} spans ending in {names[-3:]}")
+
+
+if __name__ == "__main__":
+    main()

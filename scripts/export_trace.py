@@ -1,0 +1,38 @@
+"""Render a run's telemetry span logs into a Perfetto trace.json.
+
+The learner and every worker/gather/batcher child write per-process
+span logs (``spans-<pid>.jsonl``) next to the run's ``metrics.jsonl``
+(see docs/observability.md); this tool merges them into the Trace
+Event Format that https://ui.perfetto.dev and ``chrome://tracing``
+load directly.  Spans carrying a propagated trace context keep it in
+``args.trace``, so one episode's worker -> gather -> learner journey
+can be followed across process tracks.
+
+Usage:
+  python scripts/export_trace.py <run_dir> [out.json]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from handyrl_tpu.telemetry.export import export_run  # noqa: E402
+
+
+def main():
+    if len(sys.argv) < 2:
+        print(__doc__)
+        sys.exit(1)
+    run_dir = sys.argv[1]
+    out = sys.argv[2] if len(sys.argv) > 2 else None
+    path, count = export_run(run_dir, out)
+    if count == 0:
+        print(f"no spans found under {run_dir} (is telemetry on and "
+              f"metrics_path set?)")
+        sys.exit(1)
+    print(f"wrote {count} events to {path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -96,6 +96,14 @@ def moving_average(xs, n):
     return out
 
 
+def series(xs, epochs, key):
+    """(x, y) points for one metric, skipping records that lack the
+    key — older metrics.jsonl files predate newer metric keys and must
+    still plot instead of raising KeyError."""
+    return [(x, e[key]) for x, e in zip(xs, epochs)
+            if key in e and e[key] is not None]
+
+
 def plot(epochs, out_prefix):
     import matplotlib
 
@@ -134,7 +142,7 @@ def plot(epochs, out_prefix):
     if loss_keys:
         fig, ax = plt.subplots(figsize=(8, 5))
         for k in loss_keys:
-            pts = [(x, e[k]) for x, e in zip(xs, epochs) if k in e]
+            pts = series(xs, epochs, k)
             if pts:
                 ax.plot(*zip(*pts), label=k)
         ax.set_xlabel("epoch")
@@ -154,7 +162,7 @@ def plot(epochs, out_prefix):
     if guard_keys:
         fig, ax = plt.subplots(figsize=(8, 5))
         for k in guard_keys:
-            pts = [(x, e[k]) for x, e in zip(xs, epochs) if k in e]
+            pts = series(xs, epochs, k)
             if pts:
                 ax.plot(*zip(*pts), label=k, marker=".")
         ax.set_xlabel("epoch")
@@ -178,7 +186,7 @@ def plot(epochs, out_prefix):
     if fleet_keys:
         fig, ax = plt.subplots(figsize=(8, 5))
         for k in fleet_keys:
-            pts = [(x, e[k]) for x, e in zip(xs, epochs) if k in e]
+            pts = series(xs, epochs, k)
             if pts:
                 ax.plot(*zip(*pts), label=k, marker=".")
         ax.set_xlabel("epoch")
@@ -188,6 +196,41 @@ def plot(epochs, out_prefix):
         fig.savefig(out_prefix + "_fleet.png", dpi=120,
                     bbox_inches="tight")
         print(f"wrote {out_prefix}_fleet.png")
+
+    # pipeline telemetry (handyrl_tpu.telemetry via the metrics jsonl):
+    # policy_lag_* is the off-policy staleness of the consumed episodes
+    # (an IMPALA learner's central health signal — a climbing lag means
+    # the actors cannot keep up with the update rate); batch_wait_sec
+    # vs device_step_sec splits each epoch's wall time into feed
+    # starvation vs device work, and queue_depth is the feed backlog at
+    # the epoch boundary
+    lag_keys = [k for k in ("policy_lag_mean", "policy_lag_p95",
+                            "policy_lag_max", "queue_depth")
+                if any(k in e for e in epochs)]
+    sec_keys = [k for k in ("batch_wait_sec", "device_step_sec",
+                            "epoch_wall_sec")
+                if any(k in e for e in epochs)]
+    if lag_keys or sec_keys:
+        fig, ax = plt.subplots(figsize=(8, 5))
+        for k in lag_keys:
+            pts = series(xs, epochs, k)
+            if pts:
+                ax.plot(*zip(*pts), label=k, marker=".")
+        ax.set_xlabel("epoch")
+        ax.set_ylabel("episodes (lag) / batches (depth)")
+        ax2 = ax.twinx()
+        for k in sec_keys:
+            pts = series(xs, epochs, k)
+            if pts:
+                ax2.plot(*zip(*pts), label=k, linestyle="--")
+        ax2.set_ylabel("seconds per epoch")
+        lines, labels = ax.get_legend_handles_labels()
+        lines2, labels2 = ax2.get_legend_handles_labels()
+        ax.legend(lines + lines2, labels + labels2, fontsize=8)
+        ax.grid(alpha=0.3)
+        fig.savefig(out_prefix + "_pipeline.png", dpi=120,
+                    bbox_inches="tight")
+        print(f"wrote {out_prefix}_pipeline.png")
 
     # generation stats (mean +- std band)
     pts = [(x, e["generation_mean"], e.get("generation_std", 0.0))

@@ -21,3 +21,17 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402  (import after env setup on purpose)
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _disarm_telemetry():
+    """Telemetry state is process-global (configured by Learner init
+    from its args): start every test disarmed so a learner-driven test
+    cannot leak armed tracing — and its trace stamps — into unrelated
+    tests that assert exact wire formats."""
+    from handyrl_tpu import telemetry
+
+    telemetry.configure(enabled=False)
+    yield

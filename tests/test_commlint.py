@@ -163,6 +163,67 @@ def test_return_verb_summary_through_instance_attr():
     assert {"episode", "result"} <= set(an.sent_verbs)
 
 
+def test_trace_codec_send_is_transparent():
+    """The telemetry envelope codec is a send head, not a new verb: a
+    literal verb wrapped in ``wrap_trace(...)`` is still collected (and
+    still trips unhandled-verb when nothing handles it), while the
+    envelope head constant itself never appears in the graph."""
+    src = (
+        "HEAD = '!tr'\n\n\n"
+        "def wrap_trace(msg):\n"
+        "    ctx = _ctx()\n"
+        "    if ctx is None:\n"
+        "        return msg\n"
+        "    return (HEAD, ctx, msg)\n\n\n"
+        "def handler(hub):\n"
+        "    conn, (verb, payload) = hub.recv(timeout=0.3)\n"
+        "    if verb == 'ping':\n"
+        "        hub.send(conn, None)\n\n\n"
+        "def client(conn, x):\n"
+        "    conn.send(wrap_trace(('zap', x)))\n")
+    from handyrl_tpu.analysis.astutil import ModuleInfo, Package
+
+    package = Package([ModuleInfo("m", "m", src)])
+    an = analyze_comm(package)
+    assert "zap" in an.sent_verbs        # seen THROUGH the codec
+    assert "!tr" not in an.sent_verbs    # the envelope head is no verb
+    findings = lint_source(src, comm=True,
+                           select=["unhandled-verb"])
+    assert [f.rule for f in findings] == ["unhandled-verb"]
+
+
+def test_trace_codec_recv_binds_verb_vars():
+    """``verb, payload = unwrap_trace(conn.recv())`` still binds the
+    verb variable, so branch handlers behind the codec stay in the
+    handled set."""
+    src = (
+        "def unwrap_trace(msg):\n"
+        "    if isinstance(msg, tuple) and len(msg) == 3:\n"
+        "        return msg[2]\n"
+        "    return msg\n\n\n"
+        "def serve(conn):\n"
+        "    while True:\n"
+        "        verb, payload = unwrap_trace(conn.recv(timeout=1))\n"
+        "        if verb == 'ping':\n"
+        "            conn.send(('pong', None))\n")
+    from handyrl_tpu.analysis.astutil import ModuleInfo, Package
+
+    package = Package([ModuleInfo("m", "m", src)])
+    an = analyze_comm(package)
+    assert "ping" in an.handled_verbs
+
+
+def test_repo_envelope_codec_stays_out_of_the_graph():
+    """The shipped package uses the codec for real (TracedConnection,
+    the QueueCommunicator queue boundaries): the envelope head must
+    not leak into the protocol graph as a sent or handled verb."""
+    package, _, errors = load_package([REPO_PACKAGE])
+    assert errors == []
+    an = analyze_comm(package)
+    assert "!tr" not in an.sent_verbs
+    assert "!tr" not in an.handled_verbs
+
+
 def test_spawn_context_tracked_cross_module():
     """A spawn context constructed in one module stays recognized when
     imported into another (the repo shape: connection._mp), while a

@@ -48,8 +48,8 @@ def test_no_phantom_keys_documented():
 
 def test_docs_exist():
     for name in ("api.md", "custom_environment.md",
-                 "large_scale_training.md", "parameters.md",
-                 "static_analysis.md"):
+                 "large_scale_training.md", "observability.md",
+                 "parameters.md", "static_analysis.md"):
         path = os.path.join(os.path.dirname(DOCS), name)
         assert os.path.exists(path), f"missing doc {name}"
 

@@ -30,6 +30,7 @@ def _stub_learner(tmp_path, monkeypatch, epochs_on_disk=(3, 4)):
     lrn.eval_rate = 0.0
     lrn.jobs_generated = 1
     lrn.jobs_evaluated = 1
+    lrn._policy_lags = []  # intake telemetry (policy_lag_* reduction)
     return lrn
 
 

@@ -66,7 +66,7 @@ def test_pool_wire_format_and_batch():
     episodes = _collect(pool, job, models, 6)
     for ep in episodes:
         assert set(ep) == {"args", "steps", "outcome", "moment",
-                           "final_model_epoch"}
+                           "final_model_epoch", "gen_model_epoch"}
         moments = [m for blob in ep["moment"]
                    for m in decompress_moments(
                        {"moment": [blob], "start": 0, "base": 0,

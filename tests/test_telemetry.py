@@ -1,0 +1,311 @@
+"""Telemetry: spans, trace-context propagation, the flight recorder,
+the Perfetto exporter, and the status endpoint.
+
+The propagation tests are the PR's protocol contract: a framed round
+trip carries trace ids across a live pipe, a pre-envelope peer (raw
+``(verb, payload)``) still interoperates, and the flight-recorder ring
+evicts oldest-first under an injectable clock.  All deterministic, no
+sleeps on the assert path."""
+
+import json
+import multiprocessing as mp
+import os
+import urllib.request
+
+import pytest
+
+from handyrl_tpu import telemetry
+from handyrl_tpu.analysis.guards import StallWatchdog
+from handyrl_tpu.connection import (
+    QueueCommunicator,
+    TracedConnection,
+)
+from handyrl_tpu.telemetry.export import build_trace, collect_run
+
+
+@pytest.fixture(autouse=True)
+def _reset_telemetry():
+    """Every test starts from a disarmed state and leaves one behind
+    (the module state is process-global)."""
+    telemetry.configure(enabled=False)
+    yield
+    telemetry.configure(enabled=False)
+
+
+def _ticker(start=0.0, step=1.0):
+    t = {"now": start}
+
+    def clock():
+        t["now"] += step
+        return t["now"]
+
+    return clock
+
+
+# -- spans --------------------------------------------------------------
+
+def test_trace_span_records_against_injectable_clock():
+    telemetry.configure(enabled=True, clock=_ticker())
+    with telemetry.trace_span("work", k="v"):
+        pass
+    spans = telemetry.stats()["ring_spans"]
+    assert spans == 1
+    # the ring holds the record with the injected timestamps
+    rec = list(telemetry.spans._state.ring)[0]
+    assert rec["name"] == "work"
+    assert rec["dur"] == pytest.approx(1.0)  # one clock tick inside
+    assert rec["attrs"] == {"k": "v"}
+
+
+def test_disabled_telemetry_records_nothing_and_wraps_nothing():
+    telemetry.configure(enabled=False)
+    with telemetry.trace_span("work"):
+        pass
+    assert telemetry.stats()["ring_spans"] == 0
+    assert telemetry.maybe_trace() is None
+    msg = ("episode", {"x": 1})
+    assert telemetry.wrap_trace(msg) is msg  # wire format untouched
+
+
+def test_sample_rate_zero_never_traces():
+    telemetry.configure(enabled=True, sample_rate=0.0)
+    assert all(telemetry.maybe_trace() is None for _ in range(32))
+
+
+def test_span_log_file_written_and_flushed(tmp_path):
+    telemetry.configure(enabled=True, log_dir=str(tmp_path),
+                        role="learner")
+    for i in range(3):
+        with telemetry.trace_span(f"s{i}"):
+            pass
+    telemetry.flush()
+    files = [f for f in os.listdir(tmp_path) if f.startswith("spans-")]
+    assert len(files) == 1
+    with open(tmp_path / files[0]) as f:
+        lines = [json.loads(line) for line in f if line.strip()]
+    assert lines[0]["meta"]["role"] == "learner"
+    assert [r["name"] for r in lines[1:]] == ["s0", "s1", "s2"]
+
+
+# -- trace context over the wire ---------------------------------------
+
+def test_envelope_round_trip_carries_ids_across_a_live_pipe():
+    telemetry.configure(enabled=True)
+    a, b = mp.get_context("spawn").Pipe(duplex=True)
+    try:
+        sender, receiver = TracedConnection(a), TracedConnection(b)
+        ctx = telemetry.new_trace()
+        telemetry.set_trace(ctx)
+        sender.send(("episode", {"steps": 9}))
+        telemetry.clear_trace()
+        assert telemetry.current_trace() is None
+        msg = receiver.recv()
+        # the payload arrives intact AND the sender's context is
+        # adopted into the receiving thread
+        assert msg == ("episode", {"steps": 9})
+        assert telemetry.current_trace() == ctx
+        # the reply direction works the same way
+        receiver.send(("ack", None))
+        telemetry.clear_trace()
+        assert sender.recv() == ("ack", None)
+        assert telemetry.current_trace() == ctx
+    finally:
+        a.close()
+        b.close()
+
+
+def test_pre_envelope_peer_interoperates():
+    """A raw (verb, payload) from a peer that predates the envelope
+    passes through unchanged — and clears any stale context instead of
+    letting it bleed into unrelated spans."""
+    telemetry.configure(enabled=True)
+    a, b = mp.get_context("spawn").Pipe(duplex=True)
+    try:
+        receiver = TracedConnection(b)
+        telemetry.set_trace(telemetry.new_trace())  # stale context
+        a.send(("args", None))                      # raw, no envelope
+        assert receiver.recv() == ("args", None)
+        assert telemetry.current_trace() is None
+        # and an untraced TracedConnection sender IS a raw peer
+        TracedConnection(a).send(("beat", {"n": 1}))
+        assert b.recv() == ("beat", {"n": 1})       # raw on the wire
+    finally:
+        a.close()
+        b.close()
+
+
+def test_queue_communicator_codecs_at_the_handling_thread():
+    """The learner/gather hubs codec at their queue boundaries: the
+    reply enqueued while a request's context is current carries it."""
+    telemetry.configure(enabled=True)
+    ours, theirs = mp.get_context("spawn").Pipe(duplex=True)
+    hub = QueueCommunicator([ours])
+    worker = TracedConnection(theirs)
+    try:
+        ctx = telemetry.new_trace()
+        telemetry.set_trace(ctx)
+        worker.send(("episode", {"steps": 3}))
+        telemetry.clear_trace()
+        conn, (verb, payload) = hub.recv(timeout=5)
+        assert (verb, payload) == ("episode", {"steps": 3})
+        assert telemetry.current_trace() == ctx  # adopted HERE
+        hub.send(conn, None)                     # reply carries ctx
+        telemetry.clear_trace()
+        assert worker.recv() is None
+        assert telemetry.current_trace() == ctx
+    finally:
+        hub.shutdown()
+        ours.close()
+        theirs.close()
+
+
+def test_payload_trace_adopts_stamped_context():
+    telemetry.configure(enabled=True)
+    ctx = telemetry.new_trace()
+    with telemetry.payload_trace({"trace": ctx, "steps": 1}):
+        assert telemetry.current_trace() == tuple(ctx)
+    assert telemetry.current_trace() is None
+    with telemetry.payload_trace({"steps": 1}):  # unstamped: no-op
+        assert telemetry.current_trace() is None
+
+
+# -- flight recorder ----------------------------------------------------
+
+def test_ring_evicts_oldest_first_under_injectable_clock(tmp_path):
+    clock = _ticker()
+    telemetry.configure(enabled=True, ring=4, log_dir=str(tmp_path),
+                        primary=True, clock=clock)
+    for i in range(7):
+        telemetry.add_event(f"e{i}")
+    path = telemetry.dump("test")
+    with open(path) as f:
+        doc = json.load(f)
+    names = [s["name"] for s in doc["spans"]]
+    assert names == ["e3", "e4", "e5", "e6"]  # oldest evicted first
+    ts = [s["ts"] for s in doc["spans"]]
+    assert ts == sorted(ts)  # ring order is time order
+    assert doc["reason"] == "test"
+
+
+def test_forced_stall_produces_exactly_one_dump(tmp_path):
+    """The repo-gate contract: one induced stall = one flight-recorder
+    dump, with the stall event in the ring — driven entirely through
+    an injectable clock (the watchdog's and the recorder's)."""
+    telemetry.configure(enabled=True, ring=64, log_dir=str(tmp_path),
+                        primary=True)
+    t = [0.0]
+    dog = StallWatchdog(max_stall_seconds=10.0, clock=lambda: t[0])
+    dog.on_stall = telemetry.stall_hook
+    dog.beat("server")
+    dog.beat("recv_loop")
+    t[0] = 5.0
+    assert dog.sample() == 0                  # within budget: no dump
+    assert telemetry.dump_count() == 0
+    t[0] = 11.0
+    dog.beat("recv_loop")                     # one loop stays healthy
+    assert dog.sample() == 1                  # server NEWLY stalled
+    assert telemetry.dump_count() == 1        # exactly one dump
+    assert dog.sample() == 0                  # still stalled: no re-dump
+    assert telemetry.dump_count() == 1
+    with open(tmp_path / "flightrec.json") as f:
+        doc = json.load(f)
+    assert doc["reason"] == "stall_event"
+    stalls = [s for s in doc["spans"] if s["name"] == "stall"]
+    assert len(stalls) == 1
+    assert stalls[0]["attrs"]["loop"] == "server"
+
+
+def test_crash_dump_writes_flightrec(tmp_path):
+    telemetry.configure(enabled=True, log_dir=str(tmp_path),
+                        primary=True)
+    telemetry.crash_dump("trainer", RuntimeError("boom"))
+    with open(tmp_path / "flightrec.json") as f:
+        doc = json.load(f)
+    assert doc["reason"] == "crash"
+    assert any(s["name"] == "crash" for s in doc["spans"])
+
+
+def test_dump_without_run_dir_is_a_noop():
+    telemetry.configure(enabled=True, log_dir=None)
+    assert telemetry.dump("test") is None
+    assert telemetry.dump_count() == 0
+
+
+# -- exporter -----------------------------------------------------------
+
+def test_exporter_builds_perfetto_loadable_events(tmp_path):
+    telemetry.configure(enabled=True, log_dir=str(tmp_path),
+                        role="learner")
+    ctx = telemetry.new_trace()
+    telemetry.set_trace(ctx)
+    telemetry.record_span("rpc.episode", 1.0, 0.25)
+    telemetry.add_event("episode.intake")
+    telemetry.clear_trace()
+    telemetry.flush()
+    roles, spans = collect_run(str(tmp_path))
+    assert roles == {os.getpid(): "learner"}
+    doc = build_trace(spans, roles)
+    events = doc["traceEvents"]
+    meta = [e for e in events if e["ph"] == "M"]
+    assert meta[0]["args"]["name"] == "learner"
+    complete = [e for e in events if e["ph"] == "X"]
+    assert complete[0]["name"] == "rpc.episode"
+    assert complete[0]["ts"] == pytest.approx(1.0e6)   # us
+    assert complete[0]["dur"] == pytest.approx(0.25e6)
+    assert complete[0]["args"]["trace"] == format(ctx[0], "x")
+    instant = [e for e in events if e["ph"] == "i"]
+    assert instant[0]["name"] == "episode.intake"
+    json.dumps(doc)  # serializable end to end
+
+
+def test_exporter_merges_processes_by_trace_id():
+    """Two processes' span records sharing one propagated trace id end
+    up in one document, distinguishable by pid — the cross-process
+    property the e2e drive asserts on real logs."""
+    spans = [
+        {"name": "episode.rollout", "ts": 1.0, "dur": 0.5, "pid": 11,
+         "tid": 1, "trace": 0xabc, "parent": 1},
+        {"name": "rpc.episode", "ts": 2.0, "dur": 0.1, "pid": 22,
+         "tid": 2, "trace": 0xabc, "parent": 2},
+    ]
+    doc = build_trace(spans, {11: "worker-0", 22: "learner"})
+    traced = [e for e in doc["traceEvents"]
+              if e.get("args", {}).get("trace") == "abc"]
+    assert {e["pid"] for e in traced} == {11, 22}
+
+
+# -- policy-lag reduction ----------------------------------------------
+
+def test_summarize_lags():
+    out = telemetry.summarize_lags([0, 0, 1, 1, 2, 8])
+    assert out["policy_lag_mean"] == pytest.approx(2.0)
+    assert out["policy_lag_max"] == 8.0
+    assert out["policy_lag_p95"] == 8.0
+    empty = telemetry.summarize_lags([])
+    assert empty == {"policy_lag_mean": 0.0, "policy_lag_p95": 0.0,
+                     "policy_lag_max": 0.0}
+    ones = telemetry.summarize_lags([1] * 100)
+    assert ones["policy_lag_p95"] == 1.0
+
+
+# -- status endpoint ----------------------------------------------------
+
+def test_status_endpoint_serves_live_json():
+    from handyrl_tpu.telemetry.status import StatusServer
+
+    calls = {"n": 0}
+
+    def snapshot():
+        calls["n"] += 1
+        return {"epoch": 7, "fleet": {"fleet_size": 2}}
+
+    server = StatusServer(0, snapshot)  # port 0: OS-assigned
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/", timeout=5) as resp:
+            assert resp.status == 200
+            doc = json.loads(resp.read())
+        assert doc == {"epoch": 7, "fleet": {"fleet_size": 2}}
+        assert calls["n"] == 1
+    finally:
+        server.close()

@@ -58,6 +58,11 @@ def test_local_training_two_epochs(tmp_path, monkeypatch):
             "stall_watchdog": True,
             "max_stall_seconds": 30.0,
             "metrics_path": "metrics.jsonl",
+            # telemetry armed at the DEFAULT sample rate: the pipeline
+            # metrics must land in every epoch record, and the span
+            # logs must export to a trace whose ids cross processes
+            "telemetry": True,
+            "trace_sample_rate": 1.0,
         },
         "worker_args": {"num_parallel": 2, "server_address": ""},
     }
@@ -98,6 +103,39 @@ def test_local_training_two_epochs(tmp_path, monkeypatch):
         # no peer spoke a verb the server does not handle
         assert record["stall_events"] == 0
         assert record["unknown_verbs"] == 0
+        # pipeline telemetry, present EVERY epoch: off-policy staleness
+        # is finite and the epoch's wall time splits into feed wait vs
+        # device work (batch_wait_sec is 0.0 on the device-replay path
+        # but must be present either way)
+        import math
+
+        assert math.isfinite(record["policy_lag_max"])
+        # NOTE p95 >= mean is NOT an invariant of nearest-rank p95
+        # (96 zeros + 4 ones -> p95 0.0, mean 0.04): only chain the
+        # true invariants
+        assert record["policy_lag_max"] >= record["policy_lag_p95"] >= 0.0
+        assert record["policy_lag_max"] >= record["policy_lag_mean"] >= 0.0
+        assert "batch_wait_sec" in record
+        assert "device_step_sec" in record
+        assert record["queue_depth"] >= 0
+        assert record["epoch_wall_sec"] > 0.0
+        assert record["time_sec"] >= record["epoch_wall_sec"]
+
+    # the run's span logs export to a Perfetto trace whose propagated
+    # ids cross at least two processes (worker rollouts -> learner
+    # rpc/intake): the cross-process causality the envelope exists for
+    from handyrl_tpu.telemetry.export import collect_run, export_run
+
+    roles, spans = collect_run(".")
+    assert len(roles) >= 2, f"span logs from one process only: {roles}"
+    by_trace = {}
+    for span in spans:
+        if "trace" in span:
+            by_trace.setdefault(span["trace"], set()).add(span["pid"])
+    assert any(len(pids) >= 2 for pids in by_trace.values()), (
+        "no trace id crossed a process boundary")
+    path, count = export_run(".")
+    assert os.path.exists("trace.json") and count > 0
 
     assert os.path.exists("models/1.ckpt")
     assert os.path.exists("models/2.ckpt")
