@@ -252,10 +252,15 @@ def measure_prefetch(seed, batch_size, compute_dtype, steps=40,
 
 
 def setup_pipeline(seed, batch_size, compute_dtype, transfer_dtype,
-                   steps=30):
+                   steps=30, depth=3, cfg_over=None):
     """End-to-end learner throughput: batcher processes sampling real
     episodes -> compact wire batches -> threaded device prefetch ->
     update step.  Production training minus the actor plane.
+
+    ``depth`` sets the prefetch queue depth, ``cfg_over`` overrides
+    loss-config keys (the lag-tolerance variant uses both: deeper
+    queues under `update_algorithm: impact` vs standard — the impact
+    step threads its target params through the same trial loop).
 
     Returns (trial, stop, profile): ``trial()`` times ``steps``
     end-to-end steps and may be called repeatedly; batchers and
@@ -272,6 +277,7 @@ def setup_pipeline(seed, batch_size, compute_dtype, transfer_dtype,
     from handyrl_tpu.utils.profiling import SectionTimers
 
     model, _, cfg, episodes = seed
+    cfg = dict(cfg, **(cfg_over or {}))
     args = dict(cfg)
     args.update(
         batch_size=batch_size, num_batchers=2,
@@ -282,34 +288,44 @@ def setup_pipeline(seed, batch_size, compute_dtype, transfer_dtype,
     batcher = Batcher(args, buffer)
     batcher.run()
     prefetcher = DevicePrefetcher(
-        batcher.batch, depth=3, threads=2, obs_float=compute_dtype)
+        batcher.batch, depth=depth, threads=2, obs_float=compute_dtype)
 
     loss_cfg = LossConfig.from_config(cfg)
+    impact = loss_cfg.update_algorithm == "impact"
     optimizer = make_optimizer(1e-3)
     params = jax.tree.map(jnp.array, model.params)
+    target = jax.tree.map(jnp.array, model.params) if impact else None
     opt_state = optimizer.init(params)
     update = make_update_step(
         model, loss_cfg, optimizer, compute_dtype=compute_dtype)
 
+    def one_step(params, opt_state, target, batch):
+        if impact:
+            return update(params, opt_state, batch, target)
+        p, o, m = update(params, opt_state, batch)
+        return p, o, m, None
+
     batch = prefetcher.get(timeout=120)
-    params, opt_state, metrics = update(params, opt_state, batch)
+    params, opt_state, metrics, target = one_step(
+        params, opt_state, target, batch)
     float(metrics["total"])  # compile + warmup
 
     timers = SectionTimers()
-    state = {"params": params, "opt_state": opt_state}
+    state = {"params": params, "opt_state": opt_state, "target": target}
 
     def trial(n=steps):
-        params, opt_state = state["params"], state["opt_state"]
+        params, opt_state, target = (
+            state["params"], state["opt_state"], state["target"])
         t0 = time.perf_counter()
         for _ in range(n):
             with timers.section("batch_wait"):
                 batch = prefetcher.get(timeout=120)
             with timers.section("update"):
-                params, opt_state, metrics = update(
-                    params, opt_state, batch)
+                params, opt_state, metrics, target = one_step(
+                    params, opt_state, target, batch)
         float(metrics["total"])  # sync
         sps = n / (time.perf_counter() - t0)
-        state["params"], state["opt_state"] = params, opt_state
+        state.update(params=params, opt_state=opt_state, target=target)
         return sps
 
     def stop():
@@ -319,6 +335,60 @@ def setup_pipeline(seed, batch_size, compute_dtype, transfer_dtype,
     return (trial, stop,
             lambda: {name: v["sec"]
                      for name, v in timers.snapshot().items()})
+
+
+def lag_tolerance_main(steps=12, depths=(1, 4, 8)):
+    """Lag-tolerance variant (one JSON line, like main): sustained e2e
+    steps/s as the prefetch queue depth grows, impact-on vs impact-off.
+
+    Deeper queues are how the pipeline work (ROADMAP item 1) buys
+    throughput, and they RAISE policy lag by construction — every
+    staged batch is one more update the generating snapshot falls
+    behind.  This variant prices the IMPACT update step (a second,
+    gradient-free target forward) against the standard one at each
+    depth: the per-step cost is what the staleness tolerance costs,
+    and the depth sweep shows both paths keep their throughput as the
+    queue (and therefore the lag) grows.  The learning-side proof that
+    impact + `max_policy_lag` actually ABSORB that lag is the chaos
+    surge e2e in tests/test_resilience.py."""
+    from __graft_entry__ import _build_model_and_batch
+
+    seed4 = _build_model_and_batch(batch_size=SEED_EPS,
+                                   return_episodes=True)
+    variants = {
+        "standard": {},
+        "impact": {"update_algorithm": "impact",
+                   "target_update_interval": 16},
+    }
+    results = {}
+    for name, over in variants.items():
+        per_depth = {}
+        for depth in depths:
+            trial, stop, prof = setup_pipeline(
+                seed4, BATCH, "bfloat16", "uint8", steps=steps,
+                depth=depth, cfg_over=over)
+            try:
+                per_depth[str(depth)] = {
+                    "steps_per_sec": round(trial(), 2),
+                    "batch_wait_sec": round(
+                        prof().get("batch_wait", 0.0), 3),
+                }
+            finally:
+                stop()
+        results[name] = per_depth
+    base = results["standard"]
+    imp = results["impact"]
+    overhead = {
+        d: round(imp[d]["steps_per_sec"] / base[d]["steps_per_sec"], 3)
+        for d in base if d in imp and base[d]["steps_per_sec"]}
+    print(json.dumps({
+        "metric": "lag_tolerance_steps_per_sec_by_depth",
+        "value": imp[str(depths[-1])]["steps_per_sec"],
+        "unit": (f"steps/sec (GeeseNet bf16 e2e pipeline, impact, "
+                 f"prefetch depth {depths[-1]})"),
+        "by_depth": results,
+        "impact_vs_standard_by_depth": overhead,
+    }))
 
 
 def measure_width_sweep(seed, widths=(32, 64, 128, 256),
@@ -943,5 +1013,8 @@ if __name__ == "__main__":
         intake_child(int(tail[0]) if tail else 32)
     elif "--intake-ceiling-child" in sys.argv:
         intake_ceiling_child()
+    elif "--lag-tolerance" in sys.argv:
+        tail = [a for a in sys.argv[2:] if a.isdigit()]
+        lag_tolerance_main(steps=int(tail[0]) if tail else 12)
     else:
         main()

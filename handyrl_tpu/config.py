@@ -21,8 +21,9 @@ from typing import Any, Dict, List, Optional
 
 import yaml
 
-POLICY_TARGETS = ("MC", "TD", "VTRACE", "UPGO")
-VALUE_TARGETS = ("MC", "TD", "VTRACE", "UPGO")
+POLICY_TARGETS = ("MC", "TD", "VTRACE", "UPGO", "IMPACT")
+VALUE_TARGETS = ("MC", "TD", "VTRACE", "UPGO", "IMPACT")
+UPDATE_ALGORITHMS = ("standard", "impact")
 
 
 @dataclass
@@ -191,6 +192,33 @@ class TrainConfig:
     # kill_after, max_kills, frame_drop_prob, frame_truncate_prob,
     # frame_delay_prob, frame_delay, seed); empty = off
     chaos: Dict[str, Any] = field(default_factory=dict)
+    # -- off-policy robustness (IMPACT, arXiv:1912.00167) --
+    # "standard" (default): importance ratios against the live learner
+    # policy, score-function policy loss — the reference behavior.
+    # "impact": a target network rides the jitted update step; V-Trace
+    # ratios are computed against ITS policy and the policy loss is a
+    # two-sided surrogate clip of the current/target ratio, so the
+    # learner tolerates much staler episodes (deep queues, bursty
+    # fleets) without the correction collapsing
+    update_algorithm: str = "standard"
+    # hard target sync cadence in optimizer steps (impact); 0 = off
+    target_update_interval: int = 0
+    # Polyak target averaging coefficient (impact); wins over the
+    # interval when both are set.  0 = off
+    target_update_tau: float = 0.0
+    # importance-ratio clips, surfaced from the previously hard-wired
+    # V-Trace constants (rho: the delta/advantage weight; c: the trace
+    # accumulation weight).  Defaults keep existing runs bit-identical
+    rho_clip: float = 1.0
+    c_clip: float = 1.0
+    # IMPACT surrogate clip epsilon: the current/target ratio is
+    # clipped to [1 - eps, 1 + eps] in the policy objective
+    surrogate_clip: float = 0.2
+    # staleness budget at intake: an arriving episode whose generating
+    # snapshot is more than this many epochs old is dropped (counted
+    # as `episodes_rejected_stale` in the metrics jsonl).  0 = accept
+    # everything (the reference behavior)
+    max_policy_lag: int = 0
     # league-lite: schedule PAST-SELF opponents into generation jobs.
     # {past_epochs: K} samples one opponent seat per league job from
     # the retained checkpoints of the last K epochs; optional prob
@@ -224,9 +252,25 @@ class TrainConfig:
                     "device_replay_episodes", "updates_per_epoch",
                     "max_update_compiles", "max_resharding_copies",
                     "heartbeat_interval", "max_respawns",
-                    "max_frame_bytes", "status_port"):
+                    "max_frame_bytes", "status_port",
+                    "target_update_interval", "max_policy_lag"):
             if getattr(self, key) < 0:
                 raise ValueError(f"{key} must be >= 0")
+        if self.update_algorithm not in UPDATE_ALGORITHMS:
+            raise ValueError(
+                f"unknown update_algorithm {self.update_algorithm!r}")
+        if self.rho_clip <= 0 or self.c_clip <= 0:
+            raise ValueError("rho_clip and c_clip must be > 0")
+        if not 0.0 < self.surrogate_clip < 1.0:
+            raise ValueError("surrogate_clip must be in (0, 1)")
+        if not 0.0 <= self.target_update_tau <= 1.0:
+            raise ValueError("target_update_tau must be in [0, 1]")
+        if (self.update_algorithm == "impact"
+                and self.target_update_interval <= 0
+                and self.target_update_tau <= 0.0):
+            raise ValueError(
+                "update_algorithm: impact needs a target refresh — set "
+                "target_update_interval > 0 or target_update_tau > 0")
         if not 0.0 <= self.trace_sample_rate <= 1.0:
             raise ValueError("trace_sample_rate must be in [0, 1]")
         if self.flightrec_spans < 1:

@@ -437,11 +437,19 @@ class Trainer:
                 name="update_step")
             if self.args.get("sharding_contract_guard", True) else None)
 
+        # off-policy robustness (IMPACT): the update step threads a
+        # target network whose params start as an exact copy of the
+        # live params; checkpoints carry it so resume is exact
+        self.impact = str(args.get("update_algorithm", "standard")
+                          or "standard") == "impact"
+        self.target_params = None
         if self.num_params > 0:
             self.optimizer = make_optimizer(
                 self.default_lr * self.data_cnt_ema)
             self.params = model.params
             self.opt_state = self.optimizer.init(self.params)
+            if self.impact:
+                self.target_params = jax.tree.map(np.asarray, self.params)
             self.update_step = self.retrace_guard.wrap(
                 self._wrap_sharding(self._build_update_step()))
             self._maybe_restore_train_state()
@@ -561,6 +569,11 @@ class Trainer:
             broadcast_train_state(
                 self.params, self.opt_state, self.steps,
                 self.data_cnt_ema))
+        if self.target_params is not None:
+            # the target net rides the same one-time broadcast (in the
+            # params slot; the other slots are placeholders)
+            self.target_params = broadcast_train_state(
+                self.target_params, (), 0, 0.0)[0]
         if self.train_mesh is not None:
             self._place_global_state()
 
@@ -590,6 +603,8 @@ class Trainer:
 
         self.params = place(self.params, p_shard)
         self.opt_state = place(self.opt_state, o_shard)
+        if self.target_params is not None:
+            self.target_params = place(self.target_params, p_shard)
 
     def _maybe_restore_train_state(self):
         """Resume optimizer state on restart (the reference checkpoints
@@ -616,6 +631,12 @@ class Trainer:
                 self.opt_state, state["opt_state"])
             steps = state["steps"]
             data_cnt_ema = state["data_cnt_ema"]
+            target_params = None
+            if self.target_params is not None \
+                    and state.get("target_params") is not None:
+                target_params = jax.tree.map(
+                    lambda like, saved: jax.numpy.asarray(saved),
+                    self.target_params, state["target_params"])
         except (ValueError, TypeError, KeyError):
             # pytree structure changed (e.g. the net was modified
             # between runs): cold-start rather than crash at startup
@@ -625,9 +646,17 @@ class Trainer:
         self.opt_state = opt_state
         self.steps = steps
         self.data_cnt_ema = data_cnt_ema
+        if target_params is not None:
+            self.target_params = target_params
+        elif self.target_params is not None:
+            # checkpoint predates the target net (algorithm switched
+            # on between runs): start it from the restored params
+            print("no target params in train state: target network "
+                  "starts as a copy of the restored model")
         print(f"restored optimizer state at step {self.steps}")
 
-    def save_train_state(self, epoch, host_opt_state=None):
+    def save_train_state(self, epoch, host_opt_state=None,
+                         host_target=None):
         if host_opt_state is None:
             host_opt_state = self._to_host(self.opt_state)
         state = {
@@ -636,6 +665,13 @@ class Trainer:
             "data_cnt_ema": self.data_cnt_ema,
             "epoch": epoch,
         }
+        if self.target_params is not None:
+            # the target net is train state: resuming without it would
+            # silently restart the off-policy correction from the live
+            # params (multihost passes the collectively-fetched copy)
+            state["target_params"] = (
+                host_target if host_target is not None
+                else self._to_host(self.target_params))
         write_atomic(train_state_path(), state)
 
     def _to_host(self, tree):
@@ -747,8 +783,14 @@ class Trainer:
 
     def _do_update(self, batch):
         with self.timers.section("update"):
-            self.params, self.opt_state, metrics = self.update_step(
-                self.params, self.opt_state, batch)
+            if self.target_params is not None:
+                (self.params, self.opt_state, metrics,
+                 self.target_params) = self.update_step(
+                    self.params, self.opt_state, batch,
+                    self.target_params)
+            else:
+                self.params, self.opt_state, metrics = self.update_step(
+                    self.params, self.opt_state, batch)
         self.trace.tick()
         self.steps += 1
         return metrics
@@ -804,10 +846,16 @@ class Trainer:
                 # draw state lives on device and rides the jit
                 state = replay.device_state(self.steps)
             with self.timers.section("update"):
-                (self.params, self.opt_state,
-                 metrics, state) = self._replay_step(
-                    self.params, self.opt_state, replay.buffers,
-                    state)
+                if self.target_params is not None:
+                    (self.params, self.opt_state, metrics, state,
+                     self.target_params) = self._replay_step(
+                        self.params, self.opt_state, replay.buffers,
+                        state, self.target_params)
+                else:
+                    (self.params, self.opt_state,
+                     metrics, state) = self._replay_step(
+                        self.params, self.opt_state, replay.buffers,
+                        state)
             self.trace.tick()
             self.steps += 1
             metric_acc.append(metrics)
@@ -926,6 +974,12 @@ class Trainer:
         snapshot.params = self._to_host(self.params)
         host_opt = self._to_host(self.opt_state) if self.multihost \
             else None
+        # _to_host is a collective for cross-process-sharded leaves, so
+        # the target copy must also be fetched by EVERY process here,
+        # not inside the primary-only save below
+        host_tgt = (self._to_host(self.target_params)
+                    if self.multihost and self.target_params is not None
+                    else None)
         self.last_metrics = {k: l / data_cnt for k, l in loss_sum.items()}
         for name, v in prof.items():
             self.last_metrics[f"profile_{name}_sec"] = v["sec"]
@@ -958,11 +1012,35 @@ class Trainer:
                 self.device_replay.episodes_seen
             self.last_metrics["replay_dropped"] = \
                 self.device_replay.dropped
+        # off-policy robustness telemetry (docs/observability.md):
+        # is_clip_frac is the mean fraction of acting steps whose
+        # importance ratio hit the clip this epoch (standard: rho >
+        # rho_clip; impact: the surrogate ratio outside 1 +- eps) —
+        # the live measure of how off-policy the consumed data was
+        fracs = [float(m["clip_frac"]) for m in metric_acc
+                 if "clip_frac" in m]
+        if fracs:
+            self.last_metrics["is_clip_frac"] = round(
+                sum(fracs) / len(fracs), 4)
+        if self.target_params is not None:
+            # steps since the target net last synced (hard interval),
+            # or the Polyak EMA's effective horizon (constant by
+            # construction) — plotted next to the rejection counter
+            interval = int(
+                self.args.get("target_update_interval", 0) or 0)
+            tau = float(self.args.get("target_update_tau", 0.0) or 0.0)
+            if tau > 0.0:
+                age = round(1.0 / tau, 1)
+            elif interval > 0:
+                age = self.steps % interval
+            else:
+                age = self.steps  # frozen target: age = run length
+            self.last_metrics["target_net_age"] = age
         self.epoch += 1
         if self.primary:  # process 0 owns the (shared) checkpoint dir
             try:
                 os.makedirs(_models_dir(), exist_ok=True)
-                self.save_train_state(self.epoch, host_opt)
+                self.save_train_state(self.epoch, host_opt, host_tgt)
             except OSError:
                 pass
         return snapshot
@@ -1145,6 +1223,14 @@ class Learner:
     """Central conductor: owns the replay buffer, serves worker
     requests, reports stats, and checkpoints every epoch."""
 
+    # class-level defaults so partially-constructed learners (tests
+    # drive single subsystems via Learner.__new__) keep working: a real
+    # __init__ overrides all of these
+    worker = None
+    max_policy_lag = 0
+    episodes_rejected_stale = 0
+    _rejected_epoch = 0
+
     def __init__(self, args, net=None, remote=False):
         from .config import Config
 
@@ -1165,6 +1251,15 @@ class Learner:
         self._epoch_t = self._run_t0
         self._policy_lags = []        # episode lags consumed this epoch
         self._last_record = None      # latest metrics record (status)
+        # lag-aware admission: with max_policy_lag > 0, an episode
+        # whose generating snapshot is more than that many epochs
+        # behind is DROPPED at intake (counted, never trained on) —
+        # the budget that lets deep queues and bursty fleets run
+        # without silently poisoning the replay buffer
+        self.max_policy_lag = int(
+            self.args.get("max_policy_lag", 0) or 0)
+        self.episodes_rejected_stale = 0   # cumulative
+        self._rejected_epoch = 0           # this epoch's count
 
         self.env = make_env(env_args)
         # guarantee at least ~update_episodes^0.85 eval games per epoch
@@ -1238,6 +1333,7 @@ class Learner:
         return {
             "epoch": self.model_epoch,
             "episodes_received": self.episodes_received,
+            "episodes_rejected_stale": self.episodes_rejected_stale,
             "connections": self.worker.connection_count(),
             "time_sec": round(time.monotonic() - self._run_t0, 3),
             "fleet": self.fleet.snapshot(),
@@ -1286,6 +1382,10 @@ class Learner:
         print("updated model(%d)" % steps)
         self.model_epoch += 1
         self.model = model
+        # the chaos surge trigger runs on the learner's epoch clock
+        # (no-op without an armed monkey; see WorkerCluster.note_epoch)
+        if self.worker is not None:
+            self.worker.note_epoch(self.model_epoch)
         if not self.primary:
             # replicas serve the in-memory snapshot to their own
             # workers; only process 0 writes the checkpoint dir
@@ -1298,13 +1398,9 @@ class Learner:
         self._prune_checkpoints()
 
     # -- episode / result intake ------------------------------------
-    def _note_intake(self, episode):
-        """Per-episode telemetry at intake: the policy-version lag
-        (learner epoch now vs the snapshot that generated the episode
-        — the off-policy staleness signal reduced into `policy_lag_*`
-        per epoch) and, for trace-stamped episodes, an intake event
-        under the episode's own context so the exported trace crosses
-        the worker -> learner process boundary."""
+    def _episode_lag(self, episode):
+        """Policy-version lag of one arriving episode: learner epoch
+        now minus the snapshot epoch that generated it."""
         gen = episode.get("gen_model_epoch")
         if gen is None:
             # pre-stamp episode (or a replayed fixture): fall back to
@@ -1313,19 +1409,47 @@ class Learner:
             labels = [job["model_id"][p] for p in job["player"]]
             gen = max([l for l in labels if l >= 0],
                       default=self.model_epoch)
-        self._policy_lags.append(max(0, self.model_epoch - gen))
+        return max(0, self.model_epoch - gen)
+
+    def _note_intake(self, episode, lag=None):
+        """Per-episode telemetry at intake: the policy-version lag
+        (the off-policy staleness signal reduced into `policy_lag_*`
+        per epoch; precomputed by the admission loop when armed) and,
+        for trace-stamped episodes, an intake event under the
+        episode's own context so the exported trace crosses the
+        worker -> learner process boundary."""
+        if lag is None:
+            lag = self._episode_lag(episode)
+        self._policy_lags.append(lag)
         ctx = episode.get("trace")
         if ctx is not None and telemetry.enabled():
             prev = telemetry.current_trace()
             telemetry.set_trace(ctx)
-            telemetry.add_event("episode.intake", lag=int(
-                max(0, self.model_epoch - gen)))
+            telemetry.add_event("episode.intake", lag=int(lag))
             telemetry.set_trace(prev)  # the rpc span keeps ITS context
 
     def feed_episodes(self, episodes):
-        kept = [e for e in episodes if e is not None]
-        for episode in kept:
-            self._note_intake(episode)
+        arrived = [e for e in episodes if e is not None]
+        if self.max_policy_lag > 0:
+            # admission control: past-budget episodes are counted and
+            # dropped BEFORE any stats/buffer touch them.  Rejected
+            # episodes still tick the intake clock below — epoch
+            # cadence tracks arrivals, so a stale flood cannot stall
+            # the epoch counter while it is being shed.  The lag
+            # computed here is reused by _note_intake below
+            admitted = []
+            for episode in arrived:
+                lag = self._episode_lag(episode)
+                if lag > self.max_policy_lag:
+                    self.episodes_rejected_stale += 1
+                    self._rejected_epoch += 1
+                else:
+                    admitted.append((episode, lag))
+        else:
+            admitted = [(episode, None) for episode in arrived]
+        kept = [episode for episode, _ in admitted]
+        for episode, lag in admitted:
+            self._note_intake(episode, lag)
             job = episode["args"]
             # trained seats credit the epoch that actually finished the
             # episode (the pool may swap snapshots mid-flight; see
@@ -1347,7 +1471,7 @@ class Learner:
                     self.league_stats.setdefault(
                         label, RunningScore()).add(episode["outcome"][p])
         before = self.episodes_received
-        self.episodes_received += len(kept)
+        self.episodes_received += len(arrived)
         for mark in range(before // 100 + 1,
                           self.episodes_received // 100 + 1):
             print(mark * 100, end=" ", flush=True)
@@ -1441,9 +1565,12 @@ class Learner:
         record["time_sec"] = round(now - self._run_t0, 3)
         record["epoch_wall_sec"] = round(now - self._epoch_t, 3)
         self._epoch_t = now
-        # off-policy staleness over the episodes consumed this epoch
+        # off-policy staleness over the episodes consumed this epoch,
+        # plus how many arrivals the staleness budget rejected
         record.update(telemetry.summarize_lags(self._policy_lags))
         self._policy_lags = []
+        record["episodes_rejected_stale"] = self._rejected_epoch
+        self._rejected_epoch = 0
         self._report_win_rates(record)
         self._report_generation(record)
 

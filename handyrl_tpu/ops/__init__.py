@@ -3,5 +3,6 @@ from .targets import (
     temporal_difference,
     upgo,
     vtrace,
+    impact,
     compute_target,
 )

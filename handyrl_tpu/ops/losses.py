@@ -8,9 +8,17 @@ Semantic parity with /root/reference/handyrl/train.py:128-268:
     burn-in (``stop_gradient`` per step — GroupNorm models have no
     train/eval mode divergence, so burn-in needs no mode switch);
   * losses: V-Trace/UPGO/TD/MC targets on detached values, importance
-    ratios clipped at 1, two-player zero-sum value symmetrization,
+    ratios clipped at ``rho_clip``/``c_clip`` (both 1 by default, the
+    reference behavior), two-player zero-sum value symmetrization,
     terminal outcome bootstrap, entropy regularization decayed by
-    episode progress.
+    episode progress;
+  * ``update_algorithm: impact`` (IMPACT, arXiv:1912.00167) swaps the
+    policies behind the math: importance ratios are computed against a
+    maintained TARGET network instead of the live learner policy (so
+    V-Trace corrections stay stable however stale the episodes are),
+    and the policy loss becomes a PPO-style two-sided surrogate clip of
+    the current/target ratio.  The target params ride the jitted update
+    step (ops.update) and refresh by hard sync or Polyak average.
 
 Everything here is pure and traced once per batch geometry.
 """
@@ -23,6 +31,8 @@ from jax import lax
 
 from .targets import compute_target
 
+# reference defaults for the importance-ratio clips; the live values
+# come from LossConfig (rho_clip / c_clip surface them as config keys)
 CLIP_RHO = 1.0
 CLIP_C = 1.0
 
@@ -39,6 +49,19 @@ class LossConfig(NamedTuple):
     value_target: str
     entropy_regularization: float
     entropy_regularization_decay: float
+    # off-policy correction knobs (defaults keep existing runs
+    # bit-identical; read with .get so raw pre-PR config dicts work)
+    rho_clip: float = CLIP_RHO
+    c_clip: float = CLIP_C
+    # "standard" = live-policy ratios + score-function policy loss;
+    # "impact" = target-network ratios + clipped surrogate objective
+    update_algorithm: str = "standard"
+    surrogate_clip: float = 0.2
+    # target-network refresh cadence (impact only): hard sync every
+    # `target_update_interval` optimizer steps, or Polyak averaging
+    # with `target_update_tau` when > 0 (tau wins if both are set)
+    target_update_interval: int = 0
+    target_update_tau: float = 0.0
 
     @classmethod
     def from_config(cls, cfg) -> "LossConfig":
@@ -52,6 +75,15 @@ class LossConfig(NamedTuple):
             value_target=str(cfg["value_target"]),
             entropy_regularization=float(cfg["entropy_regularization"]),
             entropy_regularization_decay=float(cfg["entropy_regularization_decay"]),
+            rho_clip=float(cfg.get("rho_clip", CLIP_RHO) or CLIP_RHO),
+            c_clip=float(cfg.get("c_clip", CLIP_C) or CLIP_C),
+            update_algorithm=str(
+                cfg.get("update_algorithm", "standard") or "standard"),
+            surrogate_clip=float(cfg.get("surrogate_clip", 0.2) or 0.2),
+            target_update_interval=int(
+                cfg.get("target_update_interval", 0) or 0),
+            target_update_tau=float(
+                cfg.get("target_update_tau", 0.0) or 0.0),
         )
 
 
@@ -170,16 +202,22 @@ def _masked_entropy(logits, axis=-1):
 
 
 def compose_losses(outputs, log_selected_policies, total_advantages,
-                   targets, batch, cfg: LossConfig):
+                   targets, batch, cfg: LossConfig, policy_loss=None):
     """Combine policy / value / return / entropy losses (summed, not
-    averaged — the lr schedule normalizes by the data-count EMA)."""
+    averaged — the lr schedule normalizes by the data-count EMA).
+
+    ``policy_loss`` (per-element, pre-mask) replaces the default
+    score-function term when given — the IMPACT surrogate plugs in
+    here without duplicating the rest of the composition."""
     tmasks = batch["turn_mask"]
     omasks = batch["observation_mask"]
 
     losses = {}
     dcnt = tmasks.sum()
 
-    losses["p"] = (-log_selected_policies * total_advantages * tmasks).sum()
+    if policy_loss is None:
+        policy_loss = -log_selected_policies * total_advantages
+    losses["p"] = (policy_loss * tmasks).sum()
     if "value" in outputs:
         losses["v"] = (
             ((outputs["value"] - targets["value"]) ** 2) * omasks
@@ -202,9 +240,27 @@ def compose_losses(outputs, log_selected_policies, total_advantages,
     return losses, dcnt
 
 
-def compute_loss(apply_fn: Callable, params, batch, hidden, cfg: LossConfig):
-    """Full forward + target computation + loss composition."""
+def compute_loss(apply_fn: Callable, params, batch, hidden, cfg: LossConfig,
+                 target_params=None):
+    """Full forward + target computation + loss composition.
+
+    With ``cfg.update_algorithm == "impact"`` and ``target_params``
+    given, a second (gradient-free) forward through the target network
+    provides the correction policy and the bootstrap values: V-Trace
+    ratios are target/behavior, the policy loss is the clipped
+    surrogate of current/target, and the reported ``clip_frac`` is the
+    fraction of acting steps whose surrogate ratio hit the clip."""
+    impact = cfg.update_algorithm == "impact" and target_params is not None
     outputs = forward_prediction(apply_fn, params, hidden, batch, cfg)
+    tgt_outputs = None
+    if impact:
+        # gradients only flow w.r.t. `params` (grad argnums in the
+        # update core), but stop_gradient keeps the trace honest even
+        # if a caller differentiates more broadly
+        tgt_outputs = forward_prediction(
+            apply_fn, target_params, hidden, batch, cfg)
+        tgt_outputs = {k: lax.stop_gradient(v)
+                       for k, v in tgt_outputs.items()}
     if cfg.burn_in_steps > 0:
         b = cfg.burn_in_steps
         batch = {
@@ -212,10 +268,13 @@ def compute_loss(apply_fn: Callable, params, batch, hidden, cfg: LossConfig):
             if k != "observation"
         } | {"observation": batch["observation"]}
         outputs = {k: v[:, b:] for k, v in outputs.items()}
+        if tgt_outputs is not None:
+            tgt_outputs = {k: v[:, b:] for k, v in tgt_outputs.items()}
 
     actions = batch["action"]
     emasks = batch["episode_mask"]
     omasks = batch["observation_mask"]
+    tmasks = batch["turn_mask"]
     value_target_masks, return_target_masks = omasks, omasks
 
     log_selected_b = (
@@ -225,14 +284,32 @@ def compute_loss(apply_fn: Callable, params, batch, hidden, cfg: LossConfig):
     log_selected_t = (
         jnp.take_along_axis(log_policy, actions, axis=-1) * emasks
     )
+    log_selected_g = None
+    if impact:
+        log_policy_g = jax.nn.log_softmax(tgt_outputs["policy"], axis=-1)
+        log_selected_g = (
+            jnp.take_along_axis(log_policy_g, actions, axis=-1) * emasks
+        )
 
-    # importance-sampling ratios (behavior -> target), clipped at 1
-    log_rhos = lax.stop_gradient(log_selected_t) - log_selected_b
+    # importance-sampling ratios (behavior -> correction policy),
+    # clipped at rho_clip/c_clip.  Standard: the live learner policy.
+    # IMPACT: the target network's policy — stable under staleness,
+    # because the correction target moves on the sync cadence instead
+    # of every optimizer step.
+    if impact:
+        log_rhos = log_selected_g - log_selected_b
+    else:
+        log_rhos = lax.stop_gradient(log_selected_t) - log_selected_b
     rhos = jnp.exp(log_rhos)
-    clipped_rhos = jnp.clip(rhos, 0.0, CLIP_RHO)
-    cs = jnp.clip(rhos, 0.0, CLIP_C)
+    clipped_rhos = jnp.clip(rhos, 0.0, cfg.rho_clip)
+    cs = jnp.clip(rhos, 0.0, cfg.c_clip)
 
-    outputs_nograd = {k: lax.stop_gradient(v) for k, v in outputs.items()}
+    if impact:
+        # IMPACT bootstraps targets from the TARGET network's heads
+        outputs_nograd = dict(tgt_outputs)
+    else:
+        outputs_nograd = {k: lax.stop_gradient(v)
+                          for k, v in outputs.items()}
 
     if "value" in outputs_nograd:
         values_nograd = outputs_nograd["value"]
@@ -270,7 +347,29 @@ def compute_loss(apply_fn: Callable, params, batch, hidden, cfg: LossConfig):
         _, advantages["value"] = compute_target(cfg.policy_target, *value_args)
         _, advantages["return"] = compute_target(cfg.policy_target, *return_args)
 
-    total_advantages = clipped_rhos * sum(advantages.values())
-    return compose_losses(
-        outputs, log_selected_t, total_advantages, targets, batch, cfg
-    )
+    denom = tmasks.sum() + 1e-8
+    if impact:
+        # IMPACT surrogate objective: the V-Trace rho factor is
+        # replaced by the current/target ratio under a two-sided PPO
+        # clip — maximize min(r*A, clip(r, 1-eps, 1+eps)*A)
+        adv = sum(advantages.values())
+        ratio = jnp.exp(log_selected_t - log_selected_g)
+        eps = cfg.surrogate_clip
+        surrogate = jnp.minimum(
+            ratio * adv, jnp.clip(ratio, 1.0 - eps, 1.0 + eps) * adv)
+        policy_loss = -surrogate
+        clip_frac = (
+            (jnp.abs(ratio - 1.0) > eps) * tmasks).sum() / denom
+        losses, dcnt = compose_losses(
+            outputs, log_selected_t, None, targets, batch, cfg,
+            policy_loss=policy_loss)
+    else:
+        total_advantages = clipped_rhos * sum(advantages.values())
+        # how often the rho clip actually engaged: the off-policy
+        # pressure signal (0 on fresh data; grows with staleness)
+        clip_frac = ((rhos > cfg.rho_clip) * tmasks).sum() / denom
+        losses, dcnt = compose_losses(
+            outputs, log_selected_t, total_advantages, targets, batch,
+            cfg)
+    losses["clip_frac"] = clip_frac
+    return losses, dcnt

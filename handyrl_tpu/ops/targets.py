@@ -110,6 +110,20 @@ def vtrace(values, returns, rewards, lambda_, gamma, rhos, cs):
     return vs, advantages
 
 
+def impact(values, returns, rewards, lambda_, gamma, rhos, cs):
+    """IMPACT targets (arXiv:1912.00167): the V-Trace recursion driven
+    by TARGET-NETWORK importance ratios.
+
+    The estimator is numerically the V-Trace recursion — what the
+    IMPACT scheme changes is which policy produced ``rhos``/``cs``
+    (the maintained target policy instead of the live learner policy;
+    see ops.losses) and how the policy loss consumes the advantages (a
+    two-sided surrogate clip).  Kept as its own dispatch entry so a
+    ``value_target: IMPACT`` config reads explicitly and the golden
+    tests can pin the identity."""
+    return vtrace(values, returns, rewards, lambda_, gamma, rhos, cs)
+
+
 def compute_target(algorithm: str, values, returns, rewards, lmb, gamma,
                    rhos, cs, masks):
     """Dispatch to a target estimator, blending lambda with the
@@ -130,4 +144,6 @@ def compute_target(algorithm: str, values, returns, rewards, lmb, gamma,
         return upgo(values, returns, rewards, lambda_, gamma)
     if algorithm == "VTRACE":
         return vtrace(values, returns, rewards, lambda_, gamma, rhos, cs)
+    if algorithm == "IMPACT":
+        return impact(values, returns, rewards, lambda_, gamma, rhos, cs)
     raise ValueError(f"unknown target algorithm {algorithm!r}")

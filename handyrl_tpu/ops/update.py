@@ -83,24 +83,57 @@ def make_apply_fn(model, compute_dtype="float32") -> Callable:
     return apply_fn
 
 
+def refresh_target(params, target_params, opt_state, cfg: LossConfig):
+    """Next target-network params after one optimizer step (in-jit).
+
+    Polyak (``target_update_tau > 0``) wins over the hard interval
+    sync; with neither configured the target freezes (the typed config
+    layer rejects that combination for real runs).  The hard sync keys
+    off the optimizer's own step count (``InjectHyperparamsState
+    .count``), so the cadence survives checkpoints and restarts with
+    no extra host traffic."""
+    if cfg.target_update_tau > 0.0:
+        tau = cfg.target_update_tau
+        return jax.tree.map(lambda t, p: t + tau * (p - t),
+                            target_params, params)
+    if cfg.target_update_interval > 0:
+        sync = (opt_state.count % cfg.target_update_interval) == 0
+        return jax.tree.map(lambda t, p: jnp.where(sync, p, t),
+                            target_params, params)
+    return target_params
+
+
 def make_update_core(model, cfg: LossConfig,
                      optimizer: optax.GradientTransformation,
                      compute_dtype: str = "float32") -> Callable:
-    """The un-jitted ``update_step(params, opt_state, batch)`` body —
-    shared by the single-device jit below and the sharded wrapper in
-    :mod:`handyrl_tpu.parallel.update`."""
-    apply_fn = make_apply_fn(model, compute_dtype)
+    """The un-jitted update-step body — shared by the single-device jit
+    below, the sharded wrapper in :mod:`handyrl_tpu.parallel.update`,
+    and the fused replay step in :mod:`handyrl_tpu.staging`.
 
-    def loss_fn(params, batch, hidden):
-        losses, dcnt = compute_loss(apply_fn, params, batch, hidden, cfg)
+    Signature depends on the configured algorithm (static, so every
+    caller builds exactly one shape):
+
+      * standard: ``(params, opt_state, batch) ->
+        (params, opt_state, metrics)`` — unchanged;
+      * impact:   ``(params, opt_state, batch, target_params) ->
+        (params, opt_state, metrics, target_params)`` — the target
+        network rides the same jitted program, refreshed per
+        :func:`refresh_target`, so the step stays ONE compile.
+    """
+    apply_fn = make_apply_fn(model, compute_dtype)
+    impact = cfg.update_algorithm == "impact"
+
+    def loss_fn(params, batch, hidden, target_params):
+        losses, dcnt = compute_loss(apply_fn, params, batch, hidden, cfg,
+                                    target_params=target_params)
         return losses["total"], (losses, dcnt)
 
-    def update_step(params, opt_state, batch):
+    def _step(params, opt_state, batch, target_params):
         B = batch["value"].shape[0]
         P = batch["value"].shape[2]
         hidden = model.init_hidden([B, P])
         grads, (losses, dcnt) = jax.grad(loss_fn, has_aux=True)(
-            params, batch, hidden
+            params, batch, hidden, target_params
         )
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
@@ -108,14 +141,30 @@ def make_update_core(model, cfg: LossConfig,
                    "grad_norm": optax.global_norm(grads)}
         return params, opt_state, metrics
 
+    if not impact:
+        def update_step(params, opt_state, batch):
+            return _step(params, opt_state, batch, None)
+
+        return update_step
+
+    def update_step(params, opt_state, batch, target_params):
+        params, opt_state, metrics = _step(
+            params, opt_state, batch, target_params)
+        target_params = refresh_target(params, target_params, opt_state,
+                                       cfg)
+        return params, opt_state, metrics, target_params
+
     return update_step
 
 
 def make_update_step(model, cfg: LossConfig,
                      optimizer: optax.GradientTransformation,
                      compute_dtype: str = "float32") -> Callable:
-    """Build the jitted ``update_step`` for a TPUModel + config."""
-    return jax.jit(
-        make_update_core(model, cfg, optimizer, compute_dtype),
-        donate_argnums=(0, 1),
-    )
+    """Build the jitted ``update_step`` for a TPUModel + config.
+
+    The impact signature additionally donates the target params (the
+    step returns their refreshed successor)."""
+    core = make_update_core(model, cfg, optimizer, compute_dtype)
+    if cfg.update_algorithm == "impact":
+        return jax.jit(core, donate_argnums=(0, 1, 3))
+    return jax.jit(core, donate_argnums=(0, 1))

@@ -47,8 +47,13 @@ def make_sharded_update_step(model, cfg: LossConfig,
     compute shardings; pass the live params at call time as usual.
     With ``fsdp``, params + optimizer state shard over ``dp`` (ZeRO);
     XLA inserts the weight all-gathers / grad reduce-scatters.
+
+    Under ``update_algorithm: impact`` the step threads the target
+    params as a trailing argument/result, sharded exactly like the
+    live params (the target net is the same pytree).
     """
     core = make_update_core(model, cfg, optimizer, compute_dtype)
+    impact = cfg.update_algorithm == "impact"
 
     sp_size = mesh.shape["sp"]
     if shard_time and sp_size > 1:
@@ -64,9 +69,15 @@ def make_sharded_update_step(model, cfg: LossConfig,
                 return jax.lax.with_sharding_constraint(leaf, time_sharded)
             return leaf
 
-        def update_step(params, opt_state, batch):
-            return core(params, opt_state,
-                        jax.tree.map(stage_time, batch))
+        if impact:
+            def update_step(params, opt_state, batch, target_params):
+                return core(params, opt_state,
+                            jax.tree.map(stage_time, batch),
+                            target_params)
+        else:
+            def update_step(params, opt_state, batch):
+                return core(params, opt_state,
+                            jax.tree.map(stage_time, batch))
     else:
         update_step = core
 
@@ -75,6 +86,13 @@ def make_sharded_update_step(model, cfg: LossConfig,
     rep = replicated(mesh)
     o_shard = opt_state_sharding(optimizer, params, p_shard, rep)
 
+    if impact:
+        return jax.jit(
+            update_step,
+            in_shardings=(p_shard, o_shard, b_shard, p_shard),
+            out_shardings=(p_shard, o_shard, rep, p_shard),
+            donate_argnums=(0, 1, 3),
+        )
     return jax.jit(
         update_step,
         in_shardings=(p_shard, o_shard, b_shard),

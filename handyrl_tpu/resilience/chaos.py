@@ -40,6 +40,13 @@ class ChaosConfig:
     frame_truncate_prob: float = 0.0  # P(frame cut mid-payload + close)
     frame_delay_prob: float = 0.0     # P(frame delayed by frame_delay)
     frame_delay: float = 0.05         # seconds per injected delay
+    # -- scheduled surge (a preemption wave, not a dice roll): fires
+    # ONCE when the learner epoch reaches surge_epoch
+    surge_epoch: int = 0          # epoch that triggers the surge; 0 = off
+    surge_kills: int = 0          # gathers burst-killed at the surge
+    surge_respawn_hold: float = 0.0   # seconds respawns stay held after it
+    surge_hold_uploads: float = 0.0   # seconds gathers sit on their upload
+    #                                   backlog after seeing the surge epoch
     seed: int = 0                 # seeds the shared chaos RNG
 
     @classmethod
@@ -55,11 +62,11 @@ class ChaosConfig:
             p = getattr(cfg, name)
             if not 0.0 <= p <= 1.0:
                 raise ValueError(f"chaos.{name} must be in [0, 1]")
-        for name in ("kill_after", "frame_delay"):
+        for name in ("kill_after", "frame_delay", "surge_respawn_hold",
+                     "surge_hold_uploads", "max_kills", "surge_epoch",
+                     "surge_kills"):
             if getattr(cfg, name) < 0:
                 raise ValueError(f"chaos.{name} must be >= 0")
-        if cfg.max_kills < 0:
-            raise ValueError("chaos.max_kills must be >= 0")
         total = (cfg.frame_drop_prob + cfg.frame_truncate_prob
                  + cfg.frame_delay_prob)
         if total > 1.0:
@@ -80,14 +87,26 @@ class ChaosConfig:
                 or self.frame_truncate_prob > 0.0
                 or self.frame_delay_prob > 0.0)
 
+    @property
+    def surges_enabled(self) -> bool:
+        return self.surge_epoch > 0
+
 
 class ChaosMonkey:
-    """Kills supervised children on a seeded schedule.
+    """Kills supervised children on a seeded schedule, and fires
+    scheduled SURGES.
 
-    Drive it from the supervision loop: ``maybe_kill(supervisor)`` once
-    per tick.  Kills route through ``Supervisor.kill_slot`` so the
-    victim dies exactly the way a preempted host does — and the normal
-    failure -> backoff -> respawn path takes over.
+    Drive it from the supervision loop: ``maybe_kill(supervisor)`` and
+    ``maybe_surge(supervisor)`` once per tick; the learner reports its
+    epoch via :meth:`note_epoch`.  Kills route through
+    ``Supervisor.kill_slot`` so the victim dies exactly the way a
+    preempted host does — and the normal failure -> backoff -> respawn
+    path takes over.  A surge is a PREEMPTION WAVE, not a dice roll:
+    when the observed epoch reaches ``surge_epoch`` it burst-kills
+    ``surge_kills`` gathers ONCE (deterministically the lowest slots)
+    and holds every respawn for ``surge_respawn_hold`` seconds, so the
+    fleet stays degraded for a window instead of bouncing straight
+    back.
     """
 
     def __init__(self, cfg: ChaosConfig,
@@ -97,7 +116,10 @@ class ChaosMonkey:
         self.rng = rng if rng is not None else random.Random(cfg.seed)
         self.clock = clock
         self.armed_at = clock()
-        self.kills = 0
+        self.kills = 0            # dice-roll kills (capped by max_kills)
+        self.surge_kill_count = 0  # scheduled-surge kills (uncapped)
+        self.epoch = 0
+        self.surged = False
 
     def maybe_kill(self, supervisor, now: Optional[float] = None) -> bool:
         cfg = self.cfg
@@ -117,6 +139,34 @@ class ChaosMonkey:
         index, _ = targets[self.rng.randrange(len(targets))]
         self.kills += 1
         supervisor.kill_slot(index, reason=f"chaos kill #{self.kills}")
+        return True
+
+    def note_epoch(self, epoch: int):
+        """Learner-reported epoch: the surge trigger's clock."""
+        self.epoch = max(self.epoch, int(epoch))
+
+    def maybe_surge(self, supervisor, now: Optional[float] = None) -> bool:
+        """Fire the scheduled surge once the noted epoch reaches it."""
+        cfg = self.cfg
+        if not cfg.surges_enabled or self.surged:
+            return False
+        if self.epoch < cfg.surge_epoch:
+            return False
+        self.surged = True
+        if now is None:
+            now = self.clock()
+        targets = supervisor.running_children()
+        # deterministic victims (lowest slots): a surge is a scheduled
+        # event the e2e must replay exactly, so no RNG is involved.
+        # Counted apart from `kills` — the surge is a scheduled wave,
+        # not a dice roll, so it must not consume the max_kills budget
+        # reserved for the random kills
+        for index, _ in sorted(targets)[:cfg.surge_kills]:
+            self.surge_kill_count += 1
+            supervisor.kill_slot(
+                index, reason=f"chaos surge at epoch {self.epoch}")
+        if cfg.surge_respawn_hold > 0:
+            supervisor.hold_respawns(cfg.surge_respawn_hold, now=now)
         return True
 
 

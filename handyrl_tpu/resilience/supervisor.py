@@ -110,6 +110,7 @@ class Supervisor:
             i: _Slot(i) for i in range(num_slots)}
         self._lock = threading.Lock()
         self.stopped = False
+        self._hold_until = 0.0  # respawns paused until this clock time
 
     # -- bookkeeping -------------------------------------------------
     @property
@@ -189,6 +190,18 @@ class Supervisor:
             except OSError:
                 pass
 
+    def hold_respawns(self, seconds: float, now: Optional[float] = None):
+        """Pause every respawn for ``seconds`` (chaos surges: a burst
+        preemption's replacement capacity does not come back
+        instantly).  Failures are still observed and recorded — only
+        the respawn side of the state machine waits, so backoff
+        schedules and the circuit breaker stay truthful."""
+        if now is None:
+            now = self.clock()
+        with self._lock:
+            self._hold_until = max(self._hold_until, now + float(seconds))
+        print(f"supervisor: respawns held for {seconds:.1f}s")
+
     def kill_slot(self, index: int, reason: str = ""):
         """Evict a slot's child (chaos injection, missed heartbeats).
         The next ``poll`` sees the death and runs the normal
@@ -261,7 +274,8 @@ class Supervisor:
                         events.append(
                             ("dead" if slot.state is SlotState.DEAD
                              else "failure", slot.index))
-                if slot.state is SlotState.BACKOFF and now >= slot.due:
+                if (slot.state is SlotState.BACKOFF and now >= slot.due
+                        and now >= self._hold_until):
                     first = slot.respawns == 0 and not slot.failures
                     try:
                         slot.child = self.spawn(slot.index)

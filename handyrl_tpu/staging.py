@@ -73,13 +73,19 @@ def make_replay_update_step(replay, model, loss_cfg, optimizer,
     With a mesh, params/optimizer keep their usual shardings while the
     ring rides replicated and the gathered batch is constrained onto
     ``dp`` — each device materializes only its own batch rows.
+
+    Under ``update_algorithm: impact`` the signature grows the target
+    params (same treatment as ``params``): ``step(params, opt_state,
+    buffers, state, target_params)`` returning the refreshed target as
+    its last element — still ONE jitted program per training step.
     """
     from .ops.update import make_update_core
 
     core = make_update_core(model, loss_cfg, optimizer, compute_dtype)
+    impact = loss_cfg.update_algorithm == "impact"
     base_key = jax.random.PRNGKey(seed)
 
-    def step(params, opt_state, buffers, state):
+    def _draw(buffers, state):
         # state = device int32 [size, oldest, step_idx]: keeping the
         # draw scalars ON DEVICE and threading the step counter through
         # the jit means a steady-state step uploads NOTHING — three
@@ -93,10 +99,25 @@ def make_replay_update_step(replay, model, loss_cfg, optimizer,
             batch = jax.tree.map(
                 lambda x: jax.lax.with_sharding_constraint(
                     x, replay._out), batch)
-        p, o, metrics = core(params, opt_state, batch)
-        return p, o, metrics, state + jnp.asarray([0, 0, 1], jnp.int32)
+        return batch
+
+    if impact:
+        def step(params, opt_state, buffers, state, target_params):
+            batch = _draw(buffers, state)
+            p, o, metrics, t = core(params, opt_state, batch,
+                                    target_params)
+            return (p, o, metrics,
+                    state + jnp.asarray([0, 0, 1], jnp.int32), t)
+    else:
+        def step(params, opt_state, buffers, state):
+            batch = _draw(buffers, state)
+            p, o, metrics = core(params, opt_state, batch)
+            return p, o, metrics, state + jnp.asarray([0, 0, 1],
+                                                      jnp.int32)
 
     if mesh is None:
+        if impact:
+            return jax.jit(step, donate_argnums=(0, 1, 3, 4))
         return jax.jit(step, donate_argnums=(0, 1, 3))
 
     from .parallel.mesh import param_sharding, replicated
@@ -105,6 +126,13 @@ def make_replay_update_step(replay, model, loss_cfg, optimizer,
     p_shard = param_sharding(mesh, params, fsdp=fsdp)
     rep = replicated(mesh)
     o_shard = opt_state_sharding(optimizer, params, p_shard, rep)
+    if impact:
+        return jax.jit(
+            step,
+            in_shardings=(p_shard, o_shard, rep, rep, p_shard),
+            out_shardings=(p_shard, o_shard, rep, rep, p_shard),
+            donate_argnums=(0, 1, 3, 4),
+        )
     return jax.jit(
         step,
         in_shardings=(p_shard, o_shard, rep, rep),

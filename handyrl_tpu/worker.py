@@ -271,6 +271,12 @@ class Gather(QueueCommunicator):
     CACHED_VERBS = ("model",)
     CACHE_CAPACITY = 4  # per verb; epochs advance, so old keys go cold
     FLUSH_AGE = 0.5  # seconds an upload may wait for batch-mates
+    # surge-hold defaults (overridden by _init_surge; class-level so
+    # partially-constructed gathers in tests keep working)
+    _surge_epoch = 0
+    _surge_hold = 0.0
+    _surge_pending = False
+    _hold_until = 0.0
 
     def __init__(self, args, conn, gather_id):
         print(f"started gather {gather_id}")
@@ -288,6 +294,7 @@ class Gather(QueueCommunicator):
         self.heartbeat_interval = float(
             args.get("heartbeat_interval", 2.0) or 0.0)
         self._last_learner_io = time.monotonic()
+        self._init_surge(args)
 
         worker_conns = self._spawn_workers(args, gather_id)
         super().__init__(worker_conns)
@@ -306,6 +313,43 @@ class Gather(QueueCommunicator):
 
         return open_multiprocessing_connections(
             count, _spawn_worker, worker_args)
+
+    def _init_surge(self, args):
+        """Chaos surge hold (``chaos.surge_hold_uploads``): when the
+        job stream first carries a model id at or past
+        ``chaos.surge_epoch``, this gather sits on its upload backlog
+        for the hold window — episodes are still acked to workers and
+        staged, but nothing ships upstream until the window passes.
+        The transport-level face of a preemption wave: generation
+        continues while delivery browns out, and the learner then
+        drains a flood of episodes stamped with the pre-surge snapshot
+        (exactly the staleness the IMPACT/`max_policy_lag` machinery
+        exists to absorb).  Job/model round trips keep flowing, so
+        heartbeat liveness is unaffected."""
+        from .resilience import ChaosConfig
+
+        chaos = ChaosConfig.from_config(args.get("chaos") or {})
+        self._surge_epoch = chaos.surge_epoch
+        self._surge_hold = chaos.surge_hold_uploads
+        self._hold_until = 0.0
+        # disabled (or already fired): stop inspecting the job stream
+        self._surge_pending = (chaos.surges_enabled
+                               and self._surge_hold > 0)
+
+    def _note_surge(self, jobs):
+        if not self._surge_pending:
+            return
+        for job in jobs:
+            ids = (job or {}).get("model_id") or {}
+            if any(v >= self._surge_epoch for v in ids.values()):
+                self._surge_pending = False
+                self._hold_until = time.monotonic() + self._surge_hold
+                print(f"gather {self.gather_id}: surge — holding "
+                      f"uploads for {self._surge_hold:.1f}s")
+                return
+
+    def _holding_uploads(self):
+        return time.monotonic() < self._hold_until
 
     def _ask_learner(self, request):
         self.learner_conn.send(request)
@@ -327,8 +371,9 @@ class Gather(QueueCommunicator):
 
     def _serve_job(self, conn):
         if not self.job_queue:
-            self.job_queue.extend(
-                self._ask_learner(("args", [None] * self.block_size)))
+            jobs = self._ask_learner(("args", [None] * self.block_size))
+            self.job_queue.extend(jobs)
+            self._note_surge(jobs)
         self.send(conn, self.job_queue.popleft())
 
     def _serve_cached(self, conn, verb, key):
@@ -347,14 +392,38 @@ class Gather(QueueCommunicator):
             self.first_pending_t = time.perf_counter()
         self.pending_uploads.setdefault(verb, []).append(payload)
         self.pending_count += 1
-        if self.pending_count >= self.block_size:
+        if (self.pending_count >= self.block_size
+                and not self._holding_uploads()):
             self.flush_uploads()
 
-    def flush_uploads(self):
-        for verb, payloads in self.pending_uploads.items():
-            self._ask_learner((verb, payloads))
-        self.pending_uploads = {}
-        self.pending_count = 0
+    def flush_uploads(self, drain=False):
+        """Ship pending uploads upstream — at most two blocks per call.
+
+        Steady state never accumulates past one block, so the cap is
+        invisible there; it exists for the post-brownout backlog (a
+        surge hold, a slow learner): one giant frame would stall every
+        job/model round trip queued behind it AND land on the learner
+        as a single atomic intake (one epoch swallows the whole
+        backlog), where block-sized chunks drain interleaved with the
+        learner's epoch boundaries.  ``drain=True`` (shutdown) loops
+        until empty — episodes are never dropped at exit."""
+        while self.pending_count:
+            budget = self.pending_count if drain else min(
+                self.pending_count, 2 * self.block_size)
+            for verb in list(self.pending_uploads):
+                if budget <= 0:
+                    break
+                payloads = self.pending_uploads[verb]
+                take, rest = payloads[:budget], payloads[budget:]
+                budget -= len(take)
+                self.pending_count -= len(take)
+                if rest:
+                    self.pending_uploads[verb] = rest
+                else:
+                    del self.pending_uploads[verb]
+                self._ask_learner((verb, take))
+            if not drain:
+                break
 
     def _flush_if_stale(self):
         """Age-based flush: at low episode rates (big envs, few
@@ -362,6 +431,7 @@ class Gather(QueueCommunicator):
         count trigger indefinitely — ship whatever is pending once the
         oldest upload has waited FLUSH_AGE."""
         if (self.pending_count
+                and not self._holding_uploads()
                 and time.perf_counter() - self.first_pending_t
                 >= self.FLUSH_AGE):
             self.flush_uploads()
@@ -382,7 +452,7 @@ class Gather(QueueCommunicator):
                 self._stage_upload(conn, verb, payload)
             self._flush_if_stale()
         if self.pending_count:
-            self.flush_uploads()  # don't drop episodes at shutdown
+            self.flush_uploads(drain=True)  # never drop episodes at exit
 
 
 def _maybe_chaos_wrap(conn, args, gather_id):
@@ -486,14 +556,22 @@ class WorkerCluster(QueueCommunicator):
         )
         self.supervisor.start_all()
         chaos = ChaosConfig.from_config(self.args.get("chaos") or {})
-        if chaos.kills_enabled:
+        if chaos.kills_enabled or chaos.surges_enabled:
             self._monkey = ChaosMonkey(chaos)
         threading.Thread(target=self._supervise, daemon=True).start()
+
+    def note_epoch(self, epoch):
+        """Learner epoch tick: the chaos surge trigger's clock (the
+        scheduled burst preemption fires when the noted epoch reaches
+        ``chaos.surge_epoch``)."""
+        if self._monkey is not None:
+            self._monkey.note_epoch(epoch)
 
     def _supervise(self):
         while not self.shutdown_flag:
             if self._monkey is not None:
                 self._monkey.maybe_kill(self.supervisor)
+                self._monkey.maybe_surge(self.supervisor)
             self.supervisor.poll()
             time.sleep(self.POLL_INTERVAL)
 
@@ -536,6 +614,12 @@ class WorkerServer(QueueCommunicator):
         super().__init__()
         self.args = args
         self.total_worker_count = 0
+
+    def note_epoch(self, epoch):
+        """No supervised fleet here (remote gathers run under their own
+        machine-side supervisors), so there is no monkey to tick; the
+        gather-side surge hold still works remotely — it triggers off
+        the model ids in the job stream, not this call."""
 
     def _admit(self, conn):
         """Entry handshake: reserve an id block, reply merged config."""

@@ -232,6 +232,41 @@ def plot(epochs, out_prefix):
                     bbox_inches="tight")
         print(f"wrote {out_prefix}_pipeline.png")
 
+    # off-policy robustness (IMPACT / lag-aware intake via the metrics
+    # jsonl): episodes_rejected_stale counts arrivals the staleness
+    # budget dropped, target_net_age is steps since the target net
+    # last synced (or the Polyak horizon), and is_clip_frac (right
+    # axis, a fraction) is how often the importance-ratio clip engaged
+    # — rising together with policy_lag_p95 means the learner is
+    # actually absorbing stale data rather than silently training on it
+    off_cnt_keys = [k for k in ("episodes_rejected_stale",
+                                "target_net_age", "policy_lag_p95")
+                    if any(k in e for e in epochs)]
+    off_frac_keys = [k for k in ("is_clip_frac",)
+                     if any(k in e for e in epochs)]
+    if off_cnt_keys or off_frac_keys:
+        fig, ax = plt.subplots(figsize=(8, 5))
+        for k in off_cnt_keys:
+            pts = series(xs, epochs, k)
+            if pts:
+                ax.plot(*zip(*pts), label=k, marker=".")
+        ax.set_xlabel("epoch")
+        ax.set_ylabel("episodes (rejected/lag) / steps (age)")
+        ax2 = ax.twinx()
+        for k in off_frac_keys:
+            pts = series(xs, epochs, k)
+            if pts:
+                ax2.plot(*zip(*pts), label=k, linestyle="--")
+        ax2.set_ylabel("clipped-IS fraction")
+        ax2.set_ylim(0, 1)
+        lines, labels = ax.get_legend_handles_labels()
+        lines2, labels2 = ax2.get_legend_handles_labels()
+        ax.legend(lines + lines2, labels + labels2, fontsize=8)
+        ax.grid(alpha=0.3)
+        fig.savefig(out_prefix + "_offpolicy.png", dpi=120,
+                    bbox_inches="tight")
+        print(f"wrote {out_prefix}_offpolicy.png")
+
     # generation stats (mean +- std band)
     pts = [(x, e["generation_mean"], e.get("generation_std", 0.0))
            for x, e in zip(xs, epochs) if "generation_mean" in e]

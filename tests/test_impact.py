@@ -1,0 +1,311 @@
+"""IMPACT off-policy robustness: target network, clipped surrogate,
+lag-aware intake.
+
+Unit-level proofs for the staleness-tolerance layer: the surfaced
+rho/c clips default to the old hard-wired behavior, the impact update
+step threads + refreshes its target network inside ONE jitted program,
+and the learner's `max_policy_lag` admission drops (and counts) stale
+arrivals before they touch the replay buffer.  The end-to-end story —
+a chaos surge producing a real lag spike that training absorbs — lives
+in tests/test_resilience.py.
+"""
+
+import random
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from handyrl_tpu.batch import make_batch
+from handyrl_tpu.ops.losses import LossConfig, compute_loss
+from handyrl_tpu.ops.update import make_optimizer, make_update_step
+from tests.test_batch_update import CFG, _gen_episodes, _select
+
+IMPACT_CFG = dict(
+    CFG, policy_target="VTRACE", value_target="VTRACE",
+    update_algorithm="impact", target_update_interval=3,
+)
+
+
+def _batch(n=8, cfg=CFG, seed=0):
+    model, episodes = _gen_episodes(n, cfg, seed=seed)
+    return model, make_batch([_select(ep, cfg) for ep in episodes], cfg)
+
+
+def _leaves_equal(a, b):
+    import jax
+
+    return all(np.allclose(np.asarray(x), np.asarray(y))
+               for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+
+
+# -- config surface -------------------------------------------------------
+
+def test_loss_config_defaults_preserve_old_behavior():
+    """A raw pre-PR config dict (no new keys) must resolve to the old
+    hard-wired constants: rho/c clips at 1, standard algorithm — so
+    existing runs stay bit-identical."""
+    cfg = LossConfig.from_config(CFG)
+    assert cfg.rho_clip == 1.0 and cfg.c_clip == 1.0
+    assert cfg.update_algorithm == "standard"
+    assert cfg.target_update_interval == 0
+    assert cfg.target_update_tau == 0.0
+
+
+def test_config_validates_impact_keys():
+    from handyrl_tpu.config import TrainConfig
+
+    with pytest.raises(ValueError, match="update_algorithm"):
+        TrainConfig(update_algorithm="ppo")
+    with pytest.raises(ValueError, match="target refresh"):
+        TrainConfig(update_algorithm="impact")
+    with pytest.raises(ValueError, match="rho_clip"):
+        TrainConfig(rho_clip=0.0)
+    with pytest.raises(ValueError, match="surrogate_clip"):
+        TrainConfig(surrogate_clip=1.5)
+    with pytest.raises(ValueError, match="max_policy_lag"):
+        TrainConfig(max_policy_lag=-1)
+    TrainConfig(update_algorithm="impact", target_update_interval=100)
+    TrainConfig(update_algorithm="impact", target_update_tau=0.01)
+    TrainConfig(policy_target="IMPACT", value_target="IMPACT")  # enum ok
+
+
+def test_rho_clip_key_is_wired():
+    """Raising rho_clip on off-policy data must change the loss (the
+    surfaced key really drives the previously hard-wired constant)."""
+    import jax.numpy as jnp
+
+    model, batch = _batch(cfg=dict(CFG, policy_target="VTRACE",
+                                   value_target="VTRACE"))
+    # make the data off-policy: recorded behavior probs at half the
+    # current policy's, so raw rhos sit near 2 and the clip matters
+    batch = dict(batch)
+    batch["selected_prob"] = np.clip(
+        batch["selected_prob"] * 0.5, 1e-3, 1.0)
+
+    def apply_fn(params, obs, hidden):
+        return model.module.apply({"params": model.params}, obs, hidden)
+
+    def loss_at(rho_clip):
+        cfg = LossConfig.from_config(dict(
+            CFG, policy_target="VTRACE", value_target="VTRACE",
+            rho_clip=rho_clip))
+        losses, _ = compute_loss(
+            apply_fn, model.params,
+            {k: jnp.asarray(v) for k, v in batch.items()}, None, cfg)
+        return float(losses["total"]), float(losses["clip_frac"])
+
+    total1, frac1 = loss_at(1.0)
+    total2, frac2 = loss_at(2.5)
+    assert total1 != pytest.approx(total2)
+    # the clip engages often at 1.0 on this data and rarely at 2.5
+    assert frac1 > frac2
+
+
+def test_impact_clip_frac_engages_when_policies_diverge():
+    """The impact clip_frac wire must be able to leave 0: with the
+    target net perturbed away from the live params, current/target
+    ratios land outside 1 +- surrogate_clip and the reported fraction
+    is strictly positive (a dead wire reporting a constant 0 would
+    pass every smoke run, where tiny nets keep ratios inside the
+    clip)."""
+    import jax
+    import jax.numpy as jnp
+
+    model, batch = _batch(cfg=IMPACT_CFG)
+    cfg = LossConfig.from_config(IMPACT_CFG)
+
+    def apply_fn(params, obs, hidden):
+        return model.module.apply({"params": params}, obs, hidden)
+
+    jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
+    params = jax.tree.map(jnp.asarray, model.params)
+
+    # identical target: every ratio is exactly 1, nothing clips
+    losses, _ = compute_loss(apply_fn, params, jbatch, None, cfg,
+                             target_params=params)
+    assert float(losses["clip_frac"]) == 0.0
+
+    # strongly perturbed target: ratios leave [1-eps, 1+eps]
+    rng = np.random.default_rng(3)
+    perturbed = jax.tree.map(
+        lambda p: p + jnp.asarray(
+            rng.normal(0, 0.5, p.shape).astype(np.float32)), params)
+    losses, _ = compute_loss(apply_fn, params, jbatch, None, cfg,
+                             target_params=perturbed)
+    assert float(losses["clip_frac"]) > 0.0
+
+
+# -- the impact update step ----------------------------------------------
+
+def test_impact_step_runs_and_reports_clip_frac():
+    import jax
+
+    model, batch = _batch(cfg=IMPACT_CFG)
+    cfg = LossConfig.from_config(IMPACT_CFG)
+    optimizer = make_optimizer(1e-3)
+    params = model.params
+    target = jax.tree.map(np.asarray, params)
+    opt_state = optimizer.init(params)
+    update = make_update_step(model, cfg, optimizer)
+
+    params, opt_state, metrics, target = update(
+        params, opt_state, batch, target)
+    for k in ("p", "v", "ent", "total", "dcnt", "grad_norm",
+              "clip_frac"):
+        assert np.isfinite(float(metrics[k])), (k, metrics[k])
+    assert 0.0 <= float(metrics["clip_frac"]) <= 1.0
+    assert float(metrics["grad_norm"]) > 0
+
+
+def test_target_hard_sync_follows_the_interval():
+    """target == params exactly at every interval-th optimizer step,
+    and only there (the sync keys off the optimizer's own count, so it
+    survives restarts for free)."""
+    import jax
+    import jax.numpy as jnp
+
+    model, batch = _batch(cfg=IMPACT_CFG)
+    cfg = LossConfig.from_config(IMPACT_CFG)  # interval = 3
+    optimizer = make_optimizer(1e-3)
+    params = jax.tree.map(jnp.array, model.params)
+    target = jax.tree.map(jnp.array, model.params)
+    opt_state = optimizer.init(params)
+    update = make_update_step(model, cfg, optimizer)
+
+    synced = []
+    for step in range(1, 7):
+        params, opt_state, metrics, target = update(
+            params, opt_state, batch, target)
+        synced.append(_leaves_equal(params, target))
+    assert synced == [False, False, True, False, False, True]
+
+
+def test_target_polyak_moves_by_tau():
+    import jax
+    import jax.numpy as jnp
+
+    tau = 0.25
+    tau_cfg = dict(IMPACT_CFG, target_update_interval=0,
+                   target_update_tau=tau)
+    model, batch = _batch(cfg=tau_cfg)
+    cfg = LossConfig.from_config(tau_cfg)
+    optimizer = make_optimizer(1e-3)
+    params = jax.tree.map(jnp.array, model.params)
+    target0 = jax.tree.map(jnp.array, model.params)
+    opt_state = optimizer.init(params)
+    update = make_update_step(model, cfg, optimizer)
+
+    params, opt_state, _, target = update(
+        params, opt_state, batch, target0)
+    # target' = target0 + tau * (params' - target0), leaf-wise
+    expect = jax.tree.map(
+        lambda t0, p: np.asarray(t0) + tau * (np.asarray(p)
+                                              - np.asarray(t0)),
+        jax.tree.map(np.asarray, model.params), params)
+    assert _leaves_equal(target, expect)
+
+
+def test_impact_step_compiles_exactly_once():
+    """The whole impact step — two forwards, surrogate, Adam, target
+    refresh — is ONE compiled program; repeated calls never retrace."""
+    import jax
+    import jax.numpy as jnp
+
+    from handyrl_tpu.analysis.guards import RetraceGuard
+
+    model, batch = _batch(cfg=IMPACT_CFG)
+    cfg = LossConfig.from_config(IMPACT_CFG)
+    optimizer = make_optimizer(1e-3)
+    params = jax.tree.map(jnp.array, model.params)
+    target = jax.tree.map(jnp.array, model.params)
+    opt_state = optimizer.init(params)
+    guard = RetraceGuard(max_compiles=1, name="impact_step")
+    update = guard.wrap(make_update_step(model, cfg, optimizer))
+
+    for _ in range(4):
+        params, opt_state, metrics, target = update(
+            params, opt_state, batch, target)
+    assert guard.compiles == 1 and guard.calls == 4
+
+
+def test_impact_training_reduces_loss():
+    """A few impact steps on a fixed batch still learn (the surrogate
+    objective optimizes, it does not just run)."""
+    import jax
+    import jax.numpy as jnp
+
+    model, batch = _batch(n=16, cfg=IMPACT_CFG)
+    cfg = LossConfig.from_config(IMPACT_CFG)
+    optimizer = make_optimizer(3e-4)
+    params = jax.tree.map(jnp.array, model.params)
+    target = jax.tree.map(jnp.array, model.params)
+    opt_state = optimizer.init(params)
+    update = make_update_step(model, cfg, optimizer)
+
+    first_v = None
+    for _ in range(30):
+        params, opt_state, metrics, target = update(
+            params, opt_state, batch, target)
+        if first_v is None:
+            first_v = float(metrics["v"])
+    assert float(metrics["v"]) < first_v
+
+
+# -- lag-aware intake -----------------------------------------------------
+
+class _RecordingReplay:
+    def __init__(self):
+        self.got = []
+
+    def extend(self, eps):
+        self.got.extend(eps)
+
+
+def _episode(gen_epoch):
+    return {"gen_model_epoch": gen_epoch,
+            "args": {"player": [0], "model_id": {0: gen_epoch}},
+            "outcome": {0: 0.0}}
+
+
+def _intake_learner(model_epoch, budget):
+    from handyrl_tpu.learner import Learner
+
+    learner = Learner.__new__(Learner)
+    learner.model_epoch = model_epoch
+    learner.max_policy_lag = budget
+    learner.episodes_rejected_stale = 0
+    learner._rejected_epoch = 0
+    learner._policy_lags = []
+    learner.generation_stats = {}
+    learner.league_stats = {}
+    learner.episodes_received = 0
+    learner.trainer = SimpleNamespace(device_replay=None)
+    learner.replay = _RecordingReplay()
+    return learner
+
+
+def test_max_policy_lag_drops_and_counts_stale_arrivals():
+    learner = _intake_learner(model_epoch=10, budget=3)
+    learner.feed_episodes([
+        _episode(10),   # lag 0: kept
+        _episode(7),    # lag 3 == budget: kept (budget is inclusive)
+        _episode(6),    # lag 4: rejected
+        _episode(2),    # lag 8: rejected
+        None,           # dead worker slot: ignored entirely
+    ])
+    assert len(learner.replay.got) == 2
+    assert learner.episodes_rejected_stale == 2
+    assert learner._rejected_epoch == 2
+    # the intake clock counts ARRIVALS (epoch cadence must keep moving
+    # while a stale flood is being shed), lag stats count consumed only
+    assert learner.episodes_received == 4
+    assert learner._policy_lags == [0, 3]
+
+
+def test_zero_budget_accepts_everything():
+    learner = _intake_learner(model_epoch=10, budget=0)
+    learner.feed_episodes([_episode(1), _episode(10)])
+    assert len(learner.replay.got) == 2
+    assert learner.episodes_rejected_stale == 0
+    assert learner._policy_lags == [9, 0]

@@ -97,6 +97,9 @@ def train_rounds(env_name, cfg, rounds, updates_per_round, batch,
     optimizer = make_optimizer(lr)
     update = make_update_step(model, loss_cfg, optimizer)
     params = jax.tree.map(jnp.array, model.params)
+    # impact: the target net rides along (starts as a params copy)
+    target = (jax.tree.map(jnp.array, model.params)
+              if loss_cfg.update_algorithm == "impact" else None)
     opt_state = optimizer.init(params)
 
     snapshots = []
@@ -107,7 +110,11 @@ def train_rounds(env_name, cfg, rounds, updates_per_round, batch,
             b = make_batch(
                 [select_window(random.choice(episodes), cfg)
                  for _ in range(batch)], cfg)
-            params, opt_state, metrics = update(params, opt_state, b)
+            if target is not None:
+                params, opt_state, metrics, target = update(
+                    params, opt_state, b, target)
+            else:
+                params, opt_state, metrics = update(params, opt_state, b)
             assert np.isfinite(float(metrics["total"]))
         model.params = jax.tree.map(np.asarray, params)
         params = jax.tree.map(jnp.array, model.params)
@@ -181,6 +188,46 @@ def test_tictactoe_training_reaches_floor():
         for a, b in zip(jax.tree.leaves(untouched.params),
                         jax.tree.leaves(snapshots[-1].params)))
     assert moved, "training left every parameter at its initial value"
+
+
+@pytest.mark.slow
+def test_tictactoe_impact_training_reaches_floor():
+    """The IMPACT update path (target network + clipped surrogate) must
+    clear the same TicTacToe floor as the standard path: the
+    staleness-tolerance machinery may not cost learning strength on
+    on-policy data (its job is to stop degradation OFF-policy).  Same
+    pipeline, seeds, and floor as the standard test above; the
+    trajectory differs (different objective), so this also pins the
+    impact path's deterministic output.  The sign-flip tripwire is
+    inherited: a broken surrogate sign collapses this eval the same
+    way the standard path's does."""
+    random.seed(9)
+    cfg = {**TTT_CFG, "policy_target": "VTRACE",
+           "value_target": "VTRACE",
+           "update_algorithm": "impact",
+           "target_update_interval": 10}
+    env = make_env({"env": "TicTacToe"})
+    snapshots = train_rounds(
+        "TicTacToe", cfg, rounds=12, updates_per_round=5,
+        batch=32, episodes_per_round=48, lr=1e-3, seed=9,
+        snapshot_last=3)
+    rates = [eval_win_rate(env, m, games=80, seed=77 + i)
+             for i, m in enumerate(snapshots)]
+    mean_wr = sum(rates) / len(rates)
+    assert mean_wr >= 0.545, (
+        f"impact-trained TicTacToe win rates {rates} mean "
+        f"{mean_wr:.3f} < 0.545 (the standard path's floor)")
+
+    env_fresh = make_env({"env": "TicTacToe"})
+    env_fresh.reset()
+    untouched = TPUModel(env_fresh.net())
+    untouched.init_params(
+        env_fresh.observation(env_fresh.players()[0]), seed=9)
+    moved = any(
+        not np.allclose(a, b)
+        for a, b in zip(jax.tree.leaves(untouched.params),
+                        jax.tree.leaves(snapshots[-1].params)))
+    assert moved, "impact training left every parameter at its init"
 
 
 @pytest.mark.slow

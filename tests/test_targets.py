@@ -11,6 +11,7 @@ import pytest
 
 from handyrl_tpu.ops import (
     compute_target,
+    impact,
     monte_carlo,
     temporal_difference,
     upgo,
@@ -144,6 +145,52 @@ def test_vtrace_on_policy_reduces_to_td():
     vs, _ = vtrace(values, returns, rewards, lambda_, 1.0, ones, ones)
     td_tgt, _ = temporal_difference(values, returns, rewards, lambda_, 1.0)
     np.testing.assert_allclose(vs, td_tgt, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("rho_clip,c_clip", [(1.3, 1.1), (2.0, 1.0),
+                                             (0.5, 0.5)])
+def test_vtrace_nonunit_clips_match_reference(rho_clip, c_clip):
+    """V-Trace under NON-UNIT clips: ratios drawn in [0, 2] and clipped
+    at the configured rho/c ceilings (the `rho_clip`/`c_clip` config
+    keys) still match the reference recurrence exactly — the recursion
+    is clip-agnostic, the clips live in what the caller feeds it."""
+    values, returns, rewards = _rand(), _rand(), _rand()
+    lambda_ = RNG.uniform(0, 1, size=(B, T, P, 1)).astype(np.float32)
+    raw = RNG.uniform(0, 2, size=(B, T, P, 1)).astype(np.float32)
+    rhos = np.clip(raw, 0.0, rho_clip)
+    cs = np.clip(raw, 0.0, c_clip)
+    vs, adv = vtrace(values, returns, rewards, lambda_, 0.9, rhos, cs)
+    evs, eadv = _np_vtrace(values, returns, rewards, lambda_, 0.9,
+                           rhos, cs)
+    np.testing.assert_allclose(vs, evs, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(adv, eadv, rtol=1e-5, atol=1e-6)
+
+
+def test_impact_is_vtrace_with_target_ratios():
+    """The IMPACT target path is the V-Trace recursion — identical
+    outputs on identical inputs (what changes in the impact scheme is
+    WHICH policy produced the ratios, which happens in ops.losses);
+    also reachable through the compute_target dispatch as "IMPACT"."""
+    values, returns, rewards = _rand(), _rand(), _rand()
+    lambda_ = RNG.uniform(0, 1, size=(B, T, P, 1)).astype(np.float32)
+    raw = RNG.uniform(0, 2, size=(B, T, P, 1)).astype(np.float32)
+    rhos = np.clip(raw, 0.0, 1.3)
+    cs = np.clip(raw, 0.0, 1.0)
+    vs_i, adv_i = impact(values, returns, rewards, lambda_, 0.9,
+                         rhos, cs)
+    vs_v, adv_v = vtrace(values, returns, rewards, lambda_, 0.9,
+                         rhos, cs)
+    np.testing.assert_array_equal(np.asarray(vs_i), np.asarray(vs_v))
+    np.testing.assert_array_equal(np.asarray(adv_i), np.asarray(adv_v))
+
+    masks = np.ones((B, T, P, 1), np.float32)
+    vs_d, adv_d = compute_target("IMPACT", values, returns, rewards,
+                                 0.7, 0.9, rhos, cs, masks)
+    evs, eadv = _np_vtrace(
+        values, returns, rewards,
+        np.full((B, T, P, 1), 0.7, np.float32), 0.9, rhos, cs)
+    np.testing.assert_allclose(vs_d, evs, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(adv_d, eadv, rtol=1e-5, atol=1e-6)
 
 
 def test_compute_target_mask_blend():
