@@ -64,7 +64,10 @@ class TrainConfig:
     policy_target: str = "TD"
     value_target: str = "TD"
     seed: int = 0
-    restart_epoch: int = 0
+    # epoch to resume from (0 = fresh start), or "auto" to scan the
+    # checkpoint manifest for the newest VALID checkpoint — the
+    # preemption-recovery mode: no config surgery after a learner kill
+    restart_epoch: Any = 0
     worker: WorkerConfig = field(default_factory=WorkerConfig)
     eval: EvalConfig = field(default_factory=EvalConfig)
     env: Dict[str, Any] = field(default_factory=dict)
@@ -122,6 +125,33 @@ class TrainConfig:
     checkpoint_keep_last: int = 0
     # ... plus every K-th epoch regardless of age (0 = none)
     checkpoint_keep_every: int = 0
+    # -- durability (handyrl_tpu.durability) --
+    # stamp a sha256 footer on every checkpoint write and verify it on
+    # load: truncated/bit-flipped files are rejected and resume falls
+    # back to the newest valid manifest entry instead of training on
+    # garbage.  Footer-less legacy files still load either way
+    checkpoint_checksum: bool = True
+    # episode write-ahead log: admitted episodes append to segmented,
+    # crc-checksummed logs under models/wal/ so a restarted learner
+    # replays its staged backlog instead of re-generating it
+    wal_enabled: bool = True
+    # seconds between WAL fsyncs (bounds the episode-loss window of a
+    # hard kill); 0 = fsync every append
+    wal_flush_interval: float = 1.0
+    # WAL segment size before rolling to a fresh file, MiB
+    wal_segment_mb: int = 8
+    # episodes of WAL history retained for replay; 0 = follow
+    # maximum_episodes (the replay buffer's own capacity)
+    wal_keep_episodes: int = 0
+    # SIGTERM grace window, seconds: how long the preemption handler
+    # waits for the trainer to land an emergency checkpoint before the
+    # flight-recorder dump and exit.  0 = seal the WAL and dump only
+    preempt_grace_seconds: float = 5.0
+    # run the learner under a relaunch supervisor (resilience.guardian):
+    # a crashed/killed learner process restarts with `restart_epoch:
+    # auto` behind the same backoff + circuit breaker the actor fleet
+    # uses, so a poison checkpoint cannot restart-storm
+    supervise_learner: bool = False
     # retrace budget for the jitted update step, asserted by a
     # RetraceGuard after every training step: compiling more than this
     # many times per run means input shapes/dtypes are churning (each
@@ -253,9 +283,19 @@ class TrainConfig:
                     "max_update_compiles", "max_resharding_copies",
                     "heartbeat_interval", "max_respawns",
                     "max_frame_bytes", "status_port",
-                    "target_update_interval", "max_policy_lag"):
+                    "target_update_interval", "max_policy_lag",
+                    "wal_flush_interval", "wal_keep_episodes",
+                    "preempt_grace_seconds"):
             if getattr(self, key) < 0:
                 raise ValueError(f"{key} must be >= 0")
+        if self.wal_segment_mb < 1:
+            raise ValueError("wal_segment_mb must be >= 1")
+        if self.restart_epoch != "auto" and not (
+                isinstance(self.restart_epoch, int)
+                and not isinstance(self.restart_epoch, bool)
+                and self.restart_epoch >= 0):
+            raise ValueError(
+                "restart_epoch must be an epoch number >= 0 or 'auto'")
         if self.update_algorithm not in UPDATE_ALGORITHMS:
             raise ValueError(
                 f"unknown update_algorithm {self.update_algorithm!r}")

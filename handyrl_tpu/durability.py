@@ -1,0 +1,650 @@
+"""Durability: checksummed checkpoints, a manifest, and an episode WAL.
+
+Podracer-style fleets run learners on preemptible capacity, where the
+LEARNER host — not just actors — is evicted mid-epoch (PAPERS.md).  The
+resilience package (PR 3) made the worker fleet survive kills and the
+IMPACT path (PR 7) made the math survive staleness; this module closes
+the remaining gap, the learner's durable state itself:
+
+  * **Checksummed checkpoints** — ``write_checksummed`` appends a
+    sha256 footer to the atomic tmp+rename write, and ``read_verified``
+    rejects truncated/bit-flipped/zero-length files with
+    :class:`CorruptCheckpointError` instead of unpickling garbage.
+    Legacy footer-less files still load (verified by unpickling only),
+    so pre-durability runs resume unchanged.
+  * **Manifest** — :class:`CheckpointManifest` records every landed
+    epoch (path, digest, steps, wall time) in ``manifest.json``,
+    updated transactionally with each save.  The manifest is the COMMIT
+    POINT: an epoch exists once the manifest says so, and a corrupt
+    ``latest``/``train_state.ckpt`` falls back to the newest entry
+    whose on-disk bytes still match their recorded digest.
+  * **Auto-resume** — ``resolve_restart`` turns ``restart_epoch: auto``
+    (or a corrupt explicit epoch) into the newest VALID resume point,
+    loudly, so recovering from a preemption needs no config surgery.
+  * **Episode WAL** — :class:`EpisodeWAL` appends admitted episodes to
+    segmented, crc-checksummed log files (one ``write()`` per record,
+    fsync'd on a ``wal_flush_interval`` cadence) so a restarted learner
+    replays its staged/assembled backlog instead of re-generating it.
+    Segments roll when a checkpoint lands and retire once the newer
+    segments alone cover the replay-buffer capacity — an episode that
+    rotated out of the buffer was either consumed into a landed
+    checkpoint or superseded, so its log is dead weight.
+
+Everything here is plain host-side Python: no jax, no device state.
+The learner wires it up (handyrl_tpu.learner); the chaos side lives in
+resilience.chaos (``learner_kill_*``) and resilience.guardian (the
+relaunch supervisor).
+"""
+
+import hashlib
+import json
+import os
+import pickle
+import struct
+import time
+import zlib
+
+# Footer appended after the pickle payload: pickle.load reads exactly
+# one pickle stream and ignores trailing bytes, so checksummed files
+# stay loadable by legacy readers (and legacy files by this one).
+CKPT_MAGIC = b"#hrlck:"
+_FOOTER_LEN = len(CKPT_MAGIC) + 64  # magic + sha256 hexdigest
+
+MANIFEST_NAME = "manifest.json"
+
+# WAL record framing: payload length, crc32 of the payload, and a
+# monotonically increasing per-WAL sequence number (the dedup key that
+# makes double replay of a sealed segment idempotent).
+_WAL_REC = struct.Struct("!IIQ")
+_WAL_SUFFIX = ".wal"
+
+
+class CorruptCheckpointError(Exception):
+    """A checkpoint file failed digest verification (or unpickling)."""
+
+
+class _TeeHash:
+    """File wrapper that hashes bytes as pickle streams them — the
+    digest comes free, without materializing a second full copy of a
+    multi-GB train state in memory (``pickle.dumps`` would)."""
+
+    __slots__ = ("f", "h")
+
+    def __init__(self, f):
+        self.f = f
+        self.h = hashlib.sha256()
+
+    def write(self, data):
+        self.h.update(data)
+        return self.f.write(data)
+
+
+def write_checksummed(path, state, checksum=True):
+    """Atomic checkpoint write (pickle tmp + fsync + rename), with a
+    sha256 footer stamped after the payload when ``checksum`` is on.
+    The pickle STREAMS to disk (hashed in flight) — peak memory stays
+    one copy of the state.  Returns the payload digest ("" when
+    checksumming is off)."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        if checksum:
+            tee = _TeeHash(f)
+            pickle.dump(state, tee, protocol=pickle.HIGHEST_PROTOCOL)
+            digest = tee.h.hexdigest()
+            f.write(CKPT_MAGIC + digest.encode("ascii"))
+        else:
+            pickle.dump(state, f, protocol=pickle.HIGHEST_PROTOCOL)
+            digest = ""
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return digest
+
+
+def _read_footer(f, size):
+    """Footer digest of an open checkpoint file, or None (legacy)."""
+    if size <= _FOOTER_LEN:
+        return None
+    f.seek(size - _FOOTER_LEN)
+    tail = f.read(_FOOTER_LEN)
+    if tail[: len(CKPT_MAGIC)] != CKPT_MAGIC:
+        return None
+    return tail[len(CKPT_MAGIC):].decode("ascii", "replace")
+
+
+def _hash_payload(f, payload_len, chunk=1 << 20):
+    """sha256 of the first ``payload_len`` bytes, streamed in chunks —
+    verification never holds a second full copy of a multi-GB
+    checkpoint in memory (the write path's _TeeHash twin)."""
+    h = hashlib.sha256()
+    f.seek(0)
+    left = payload_len
+    while left > 0:
+        block = f.read(min(chunk, left))
+        if not block:
+            break
+        h.update(block)
+        left -= len(block)
+    return h.hexdigest()
+
+
+def _verify_open(f, path, expect_digest):
+    """Shared verification core: returns payload size after checking
+    the footer/manifest digests, raising CorruptCheckpointError."""
+    size = os.fstat(f.fileno()).st_size
+    if size == 0:
+        raise CorruptCheckpointError(f"{path}: zero-length file")
+    footer = _read_footer(f, size)
+    payload_len = size - _FOOTER_LEN if footer is not None else size
+    if footer is not None or expect_digest:
+        actual = _hash_payload(f, payload_len)
+        if footer is not None and actual != footer:
+            raise CorruptCheckpointError(
+                f"{path}: content does not match its checksum footer")
+        if expect_digest and actual != expect_digest:
+            raise CorruptCheckpointError(
+                f"{path}: content does not match the manifest digest")
+    return footer, payload_len
+
+
+def read_verified(path, expect_digest=None):
+    """Load a checkpoint, verifying its footer (and, when given, the
+    manifest-recorded ``expect_digest``).  Hashing streams in chunks
+    and the pickle streams from the file — peak memory is the loaded
+    object, not object + raw bytes.  Raises
+    :class:`CorruptCheckpointError` on any mismatch, truncation, or
+    unpickling failure; OSError passes through for missing files."""
+    with open(path, "rb") as f:
+        _verify_open(f, path, expect_digest)
+        f.seek(0)
+        try:
+            # pickle.load reads exactly one stream; the footer bytes
+            # past it are simply never consumed
+            return pickle.load(f)
+        except Exception as exc:  # truncated/garbage pickle streams
+            # raise a zoo (UnpicklingError, EOFError, ValueError, ...)
+            raise CorruptCheckpointError(f"{path}: {exc!r}") from exc
+
+
+def verify_file(path, expect_digest=None):
+    """True iff the checkpoint at ``path`` is intact; never raises.
+
+    Cheap by design: when the file carries a footer (or the caller
+    supplies a manifest digest), a streamed hash comparison IS the
+    integrity proof and nothing is unpickled — resume scans over
+    dozens of retained multi-hundred-MB checkpoints stay hash-bound.
+    Only legacy footer-less files without an expected digest fall
+    back to unpickle-verification."""
+    try:
+        with open(path, "rb") as f:
+            footer, _ = _verify_open(f, path, expect_digest)
+            if footer is not None or expect_digest:
+                return True  # digest(s) checked above
+            f.seek(0)
+            pickle.load(f)  # legacy: only unpickling can vouch
+            return True
+    except Exception:  # garbage pickle streams raise a zoo; any of
+        return False   # them means "not a valid checkpoint"
+
+
+class CheckpointManifest:
+    """``manifest.json``: the durable index of landed checkpoints.
+
+    One JSON document, rewritten transactionally (tmp + fsync +
+    rename) on every commit: ``entries`` maps epoch -> {path, digest,
+    steps, wall_time}, and ``latest`` points at the newest resume
+    point — normally the newest entry, but an emergency (SIGTERM
+    grace-window) save re-points it at ``latest.ckpt`` with
+    ``emergency: true`` so auto-resume picks up the mid-epoch state.
+    A missing or corrupt manifest degrades to empty (resume then falls
+    back to ``latest.ckpt`` scanning, see :func:`resolve_restart`)."""
+
+    def __init__(self, models_dir):
+        self.models_dir = models_dir
+        self.path = os.path.join(models_dir, MANIFEST_NAME)
+
+    def load(self):
+        try:
+            with open(self.path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            return {"version": 1, "entries": {}, "latest": None}
+        data.setdefault("entries", {})
+        data.setdefault("latest", None)
+        return data
+
+    def _write(self, data):
+        os.makedirs(self.models_dir, exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f, indent=1, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    def commit(self, epoch, path, digest, steps,
+               train_state_digest="", emergency=False):
+        """Record one landed checkpoint and re-point ``latest``."""
+        data = self.load()
+        entry = {
+            "path": path,
+            "digest": digest,
+            "steps": int(steps),
+            "wall_time": time.time(),
+            # the train-state digest AS OF this commit: restore uses
+            # it to prove the single train_state.ckpt on disk is the
+            # one that pairs with THIS epoch's params (an epoch number
+            # alone cannot — an emergency save reuses the epoch tag)
+            "train_state_digest": train_state_digest,
+        }
+        if not emergency:
+            data["entries"][str(int(epoch))] = entry
+        data["latest"] = {
+            "epoch": int(epoch),
+            "path": path,
+            "digest": digest,
+            "steps": int(steps),
+            "train_state_digest": train_state_digest,
+            "emergency": bool(emergency),
+        }
+        self._write(data)
+
+    def forget(self, epochs):
+        """Drop pruned epochs from the index (checkpoint retention)."""
+        epochs = {str(int(e)) for e in epochs}
+        data = self.load()
+        kept = {e: v for e, v in data["entries"].items()
+                if e not in epochs}
+        if len(kept) != len(data["entries"]):
+            data["entries"] = kept
+            self._write(data)
+
+    def valid_entries(self):
+        """Lazily yield (epoch, entry) pairs newest-first whose
+        on-disk files still match their recorded digests — the
+        fallback ordering.  A generator on purpose: ``newest_valid``
+        usually wants only the first hit, and verification reads the
+        whole file (hash-only, but still I/O)."""
+        data = self.load()
+        for epoch_str, entry in sorted(
+                data["entries"].items(), key=lambda kv: -int(kv[0])):
+            path = os.path.join(self.models_dir,
+                                os.path.basename(entry["path"]))
+            if verify_file(path, entry.get("digest")):
+                yield int(epoch_str), dict(entry, path=path)
+
+    def newest_valid(self, below=None):
+        """Newest (epoch, entry) that verifies, optionally restricted
+        to epochs strictly below ``below``; None when nothing does."""
+        for epoch, entry in self.valid_entries():
+            if below is not None and epoch >= below:
+                continue
+            return epoch, entry
+        return None
+
+
+class ResumePoint:
+    """Resolved restart decision: the epoch to resume as, the model
+    file to load (None = fresh init), where the decision came from
+    (``fresh`` / ``requested`` / ``manifest`` / ``emergency`` /
+    ``latest`` / ``fallback``), and the manifest-recorded digest of
+    the train state that PAIRS with these params ("" = unknown: the
+    restore falls back to the epoch-match heuristic alone)."""
+
+    __slots__ = ("epoch", "model_file", "source", "train_state_digest")
+
+    def __init__(self, epoch, model_file, source,
+                 train_state_digest=""):
+        self.epoch = int(epoch)
+        self.model_file = model_file
+        self.source = source
+        self.train_state_digest = train_state_digest or ""
+
+    def __repr__(self):
+        return (f"ResumePoint(epoch={self.epoch}, "
+                f"source={self.source!r})")
+
+
+def resolve_restart(models_dir, requested, latest_name="latest.ckpt"):
+    """Turn ``restart_epoch`` (int or "auto") into a verified
+    :class:`ResumePoint`, falling back LOUDLY when the preferred
+    checkpoint is corrupt or missing.
+
+    * ``auto``: the manifest's ``latest`` pointer (including emergency
+      saves) if its file verifies, else the newest valid manifest
+      entry, else a verifiable ``latest.ckpt`` (manifest lost), else a
+      fresh start.
+    * explicit epoch N: ``models/N.ckpt`` if it verifies; a corrupt or
+      missing file falls back to the newest valid manifest entry below
+      N (raising only when NOTHING valid exists for an explicit
+      request — an unsatisfiable ask should fail, not silently train
+      from scratch).
+    """
+    manifest = CheckpointManifest(models_dir)
+    if requested in (0, "0", None, ""):
+        return ResumePoint(0, None, "fresh")
+
+    def _entry_point(epoch, entry, source):
+        print(f"resume: epoch {epoch} from {entry['path']} "
+              f"({source}, steps {entry.get('steps', '?')})")
+        return ResumePoint(
+            epoch, entry["path"], source,
+            train_state_digest=entry.get("train_state_digest", ""))
+
+    if requested == "auto":
+        data = manifest.load()
+        latest = data.get("latest")
+        if latest:
+            path = os.path.join(models_dir,
+                                os.path.basename(latest["path"]))
+            if verify_file(path, latest.get("digest")):
+                source = ("emergency" if latest.get("emergency")
+                          else "manifest")
+                return _entry_point(latest["epoch"],
+                                    dict(latest, path=path), source)
+            print(f"WARNING: manifest latest (epoch "
+                  f"{latest.get('epoch')}) failed verification; "
+                  "falling back to older entries")
+        newest = manifest.newest_valid()
+        if newest is not None:
+            return _entry_point(*newest, "manifest")
+        # manifest gone/empty: a bare latest.ckpt is still a resume
+        # (ONE read+unpickle: the load is its own verification)
+        latest_path = os.path.join(models_dir, latest_name)
+        try:
+            state = read_verified(latest_path)
+        except (OSError, CorruptCheckpointError):
+            state = None
+        if state is not None:
+            epoch = int(state.get("epoch", 0) or 0)
+            if epoch > 0:
+                print(f"resume: epoch {epoch} from {latest_path} "
+                      "(no manifest)")
+                return ResumePoint(epoch, latest_path, "latest")
+        print("restart_epoch: auto — no valid checkpoint found; "
+              "starting fresh")
+        return ResumePoint(0, None, "fresh")
+
+    epoch = int(requested)
+    path = os.path.join(models_dir, f"{epoch}.ckpt")
+    # verify against the manifest-recorded digest when the epoch is
+    # indexed (same contract as the auto path: a self-consistent file
+    # that is NOT the committed bytes — e.g. restored from a backup of
+    # a different run — must not silently impersonate the epoch);
+    # unindexed legacy epochs verify standalone
+    entry = manifest.load()["entries"].get(str(epoch)) or {}
+    if verify_file(path, entry.get("digest") or None):
+        return ResumePoint(
+            epoch, path, "requested",
+            train_state_digest=entry.get("train_state_digest", ""))
+    print(f"WARNING: checkpoint for restart_epoch {epoch} is corrupt "
+          f"or missing ({path})")
+    newest = manifest.newest_valid(below=epoch)
+    if newest is not None:
+        fallback_epoch, entry = newest
+        print(f"WARNING: falling back to the newest valid checkpoint, "
+              f"epoch {fallback_epoch} (optimizer state for epoch "
+              f"{epoch} will cold-start unless it matches)")
+        return _entry_point(fallback_epoch, entry, "fallback")
+    raise CorruptCheckpointError(
+        f"restart_epoch {epoch}: no valid checkpoint at {path} and "
+        "no valid manifest entry to fall back to")
+
+
+class EpisodeWAL:
+    """Segmented, checksummed write-ahead log of admitted episodes.
+
+    Appends happen on the learner's server thread at intake, BEFORE
+    the episode enters the replay buffer (write-ahead).  Each record
+    is framed ``(len, crc32, seq)`` and written with ONE ``write()``
+    call so a signal handler (or a preemption) can interleave only at
+    record boundaries; fsync happens on the ``flush_interval`` cadence
+    (0 = every append).  ``roll()`` cuts the active segment when a
+    checkpoint lands, and ``retire(keep_episodes)`` drops the oldest
+    sealed segments once the newer ones alone cover the replay
+    buffer's capacity.
+
+    Replay (:meth:`replay`) verifies every record's crc: a torn or
+    corrupt record ends THAT segment's replay with a loud notice (the
+    tail of a segment after a bad record is untrusted) and continues
+    with the next segment.  The per-record ``seq`` makes replay
+    idempotent — pass one ``seen`` set across calls and each episode
+    is yielded once however many times its segment is scanned."""
+
+    def __init__(self, wal_dir, segment_bytes=8 << 20,
+                 flush_interval=1.0, clock=time.monotonic):
+        self.dir = wal_dir
+        self.segment_bytes = max(1, int(segment_bytes))
+        self.flush_interval = max(0.0, float(flush_interval))
+        self.clock = clock
+        self._f = None
+        self._f_path = None
+        self._f_bytes = 0
+        self._f_count = 0
+        self._dirty = False
+        self._last_flush = 0.0
+        # metrics (cumulative for this process)
+        self.appended = 0
+        self.flushes = 0
+        # per-segment episode counts for retirement; scanned at open
+        self._seg_counts = {}
+        self.seq = 0
+        self._scan_existing()
+
+    # -- bookkeeping --------------------------------------------------
+    def _scan_existing(self):
+        """Recover the sequence counter and per-segment episode counts
+        from whatever segments a previous incarnation left behind.
+        Header-only (frames + crc, no unpickling): on a resume the
+        replay pass deserializes every record anyway, and paying that
+        twice at startup would double the cost of exactly the restart
+        this log exists to speed up."""
+        for path in self.segments():
+            count = 0
+            for seq, _ in _iter_records(path, notice=False,
+                                        payloads=False):
+                self.seq = max(self.seq, seq)
+                count += 1
+            self._seg_counts[path] = count
+
+    def segments(self):
+        """Segment paths, oldest first (index-ordered filenames)."""
+        try:
+            names = [n for n in os.listdir(self.dir)
+                     if n.endswith(_WAL_SUFFIX)]
+        except OSError:
+            return []
+        return [os.path.join(self.dir, n)
+                for n in sorted(names, key=_seg_index)]
+
+    def episode_count(self):
+        # dict(...) snapshot: the status endpoint's handler thread
+        # reads this while the (single-writer) server thread may be
+        # mid-roll/retire — iterating the live dict there would raise
+        # "dictionary changed size during iteration"
+        return sum(dict(self._seg_counts).values()) + self._f_count
+
+    # -- append path --------------------------------------------------
+    def _open_segment(self):
+        os.makedirs(self.dir, exist_ok=True)
+        segs = self.segments()
+        index = _seg_index(os.path.basename(segs[-1])) + 1 if segs else 0
+        self._f_path = os.path.join(
+            self.dir, f"seg-{index:06d}{_WAL_SUFFIX}")
+        self._f = open(self._f_path, "ab")
+        self._f_bytes = 0
+        self._f_count = 0
+
+    def append(self, episode):
+        """Log one admitted episode; returns its sequence number."""
+        if self._f is None:
+            self._open_segment()
+        self.seq += 1
+        payload = pickle.dumps(episode,
+                               protocol=pickle.HIGHEST_PROTOCOL)
+        record = _WAL_REC.pack(
+            len(payload), zlib.crc32(payload), self.seq) + payload
+        self._f.write(record)  # ONE write: interleave-safe boundary
+        self._f_bytes += len(record)
+        self._f_count += 1
+        self.appended += 1
+        self._dirty = True
+        if self._f_bytes >= self.segment_bytes:
+            self.roll()
+        else:
+            self.maybe_flush()
+        return self.seq
+
+    def maybe_flush(self, now=None):
+        """fsync the active segment if the cadence says so."""
+        if not self._dirty or self._f is None:
+            return False
+        if now is None:
+            now = self.clock()
+        if (self.flush_interval > 0
+                and now - self._last_flush < self.flush_interval):
+            return False
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._dirty = False
+        self._last_flush = now
+        self.flushes += 1
+        return True
+
+    def seal(self):
+        """Force-fsync the active segment (SIGTERM grace window)."""
+        if self._f is None:
+            return
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._dirty = False
+        self.flushes += 1
+
+    def roll(self):
+        """Cut the active segment (a checkpoint landed): it becomes a
+        sealed, retirable unit and the next append opens a fresh one.
+        No-op while the active segment is empty."""
+        if self._f is None or self._f_count == 0:
+            return
+        self.seal()
+        self._f.close()
+        self._seg_counts[self._f_path] = self._f_count
+        self._f = None
+        self._f_path = None
+        self._f_bytes = 0
+        self._f_count = 0
+
+    def retire(self, keep_episodes):
+        """Drop the oldest SEALED segments whose episodes the newer
+        ones already cover: a segment retires only when the segments
+        after it hold >= ``keep_episodes`` episodes (the replay-buffer
+        capacity — anything older has rotated out of the buffer and
+        was consumed into a landed checkpoint).  Returns the paths
+        removed."""
+        keep_episodes = max(0, int(keep_episodes))
+        sealed = [p for p in self.segments() if p in self._seg_counts
+                  and p != self._f_path]
+        removed = []
+        for i, path in enumerate(sealed):
+            newer = sum(self._seg_counts[p] for p in sealed[i + 1:])
+            newer += self._f_count
+            if newer < keep_episodes:
+                break
+            try:
+                os.remove(path)
+            except OSError:
+                break
+            removed.append(path)
+            del self._seg_counts[path]
+        if removed:
+            print(f"wal: retired {len(removed)} segment(s) "
+                  f"({self.episode_count()} episodes retained)")
+        return removed
+
+    def checkpoint_landed(self, keep_episodes):
+        """Epoch-boundary hook: roll the active segment, then retire
+        what the landed checkpoint made dead weight."""
+        self.roll()
+        self.retire(keep_episodes)
+
+    def close(self):
+        if self._f is not None:
+            self.seal()
+            self._f.close()
+            self._f = None
+
+    # -- replay -------------------------------------------------------
+    def replay(self, seen=None):
+        """Yield ``(seq, episode)`` for every intact logged record,
+        oldest first, deduplicated against ``seen`` (a set of seqs the
+        caller keeps across calls — double replay of a sealed segment
+        admits each episode once)."""
+        if seen is None:
+            seen = set()
+        for path in self.segments():
+            for seq, episode in _iter_records(path, notice=True):
+                if seq in seen:
+                    continue
+                seen.add(seq)
+                yield seq, episode
+
+    def stats(self):
+        return {
+            "wal_appended": self.appended,
+            "wal_flushes": self.flushes,
+            "wal_segments": len(self.segments()),
+            "wal_episodes": self.episode_count(),
+        }
+
+
+def _seg_index(name):
+    base = os.path.basename(name)
+    try:
+        return int(base[len("seg-"):-len(_WAL_SUFFIX)])
+    except ValueError:
+        return -1
+
+
+def _iter_records(path, notice=True, payloads=True):
+    """Records of one segment; stops at the first torn/corrupt record
+    (the rest of that segment is untrusted).  ``payloads=False`` walks
+    frames and checks crcs without unpickling (yielding ``(seq,
+    None)``) — the cheap scan the open-time recovery uses."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return
+    offset = 0
+    while offset + _WAL_REC.size <= len(data):
+        length, crc, seq = _WAL_REC.unpack_from(data, offset)
+        start = offset + _WAL_REC.size
+        payload = data[start:start + length]
+        if len(payload) < length:
+            if notice:
+                print(f"wal: {os.path.basename(path)}: torn record at "
+                      f"byte {offset} (crash tail); replay of this "
+                      "segment stops here")
+            return
+        if zlib.crc32(payload) != crc:
+            if notice:
+                print(f"WARNING: wal: {os.path.basename(path)}: crc "
+                      f"mismatch at byte {offset}; dropping the "
+                      "segment's remaining records")
+            return
+        if payloads:
+            try:
+                episode = pickle.loads(payload)
+            except Exception:
+                if notice:
+                    print(f"WARNING: wal: {os.path.basename(path)}: "
+                          f"unpicklable record at byte {offset}; "
+                          "dropping the segment's remaining records")
+                return
+        else:
+            episode = None
+        yield seq, episode
+        offset = start + length
+    if offset < len(data) and notice:
+        print(f"wal: {os.path.basename(path)}: {len(data) - offset} "
+              "trailing bytes (torn header) ignored")

@@ -51,6 +51,14 @@ from .analysis.guards import (
 )
 from .batch import make_batch
 from .connection import MultiProcessJobExecutor
+from .durability import (
+    CheckpointManifest,
+    CorruptCheckpointError,
+    EpisodeWAL,
+    read_verified,
+    resolve_restart,
+    write_checksummed,
+)
 from .environment import make_env, prepare_env
 from .models import TPUModel, snapshot_params
 from .resilience import FleetRegistry
@@ -81,15 +89,16 @@ def train_state_path():
     return os.path.join(_models_dir(), "train_state.ckpt")
 
 
-def write_atomic(path, state):
-    """Pickle to tmp + rename so a crash mid-write can never corrupt a
-    file a restart (or a worker fetching a snapshot) will read."""
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        pickle.dump(state, f)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+def write_atomic(path, state, checksum=True):
+    """Pickle to tmp + fsync + rename so a crash mid-write can never
+    corrupt a file a restart (or a worker fetching a snapshot) will
+    read — and, with ``checksum`` on (``checkpoint_checksum``), stamp
+    a sha256 footer so a restart can PROVE the bytes it found are the
+    bytes that were written (durability.read_verified rejects
+    truncation and bit rot; the footer trails the pickle stream, so
+    legacy readers still load the file).  Returns the content digest
+    ("" when checksumming is off)."""
+    return write_checksummed(path, state, checksum=checksum)
 
 
 def _batch_worker(conn, bid, cfg):
@@ -399,6 +408,14 @@ class Trainer:
         self.shutdown_flag = False
         self.failure = None
         self.stall_beat = None   # StallWatchdog beat (set by Learner)
+        # durability: checkpoint writes stamp checksums, saves report
+        # their digest for the manifest (set by Learner), and a SIGTERM
+        # grace window can request an emergency save between steps
+        self.checkpoint_checksum = bool(
+            args.get("checkpoint_checksum", True))
+        self.manifest = None       # CheckpointManifest (set by Learner)
+        self.last_state_digest = ""
+        self.emergency = None      # threading.Event armed by SIGTERM
         self.update_queue = queue.Queue(maxsize=1)
         # multi-host: this process is one controller of a global mesh;
         # its feed builds 1/process_count of every global batch
@@ -611,13 +628,28 @@ class Trainer:
         the model only — restoring Adam moments + the lr EMA makes
         restarts seamless instead of re-warming the optimizer)."""
         restart_epoch = self.args.get("restart_epoch", 0)
-        if restart_epoch <= 0:
+        if not isinstance(restart_epoch, int) or restart_epoch <= 0:
             return
         try:
-            with open(train_state_path(), "rb") as f:
-                state = pickle.load(f)
-        except (OSError, pickle.UnpicklingError, EOFError):
-            return  # missing or truncated: cold-start the optimizer
+            # when the resume point carries a manifest-recorded train-
+            # state digest, require the file on disk to BE that file:
+            # the epoch tag alone cannot tell a boundary save from a
+            # later emergency save of the same epoch, and restoring
+            # the wrong one would pair params with a different step's
+            # optimizer moments (silently breaking exact resume)
+            state = read_verified(
+                train_state_path(),
+                expect_digest=self.args.get("_resume_state_digest")
+                or None)
+        except OSError:
+            return  # missing: cold-start the optimizer
+        except CorruptCheckpointError as exc:
+            # truncated / bit-flipped / not the state this resume
+            # point's params were saved with: refusing to trust it is
+            # the whole point of the digest — cold-start LOUDLY
+            print(f"WARNING: train state failed verification ({exc}); "
+                  "cold-starting the optimizer")
+            return
         if state.get("epoch") != restart_epoch:
             # optimizer state belongs to a different epoch's params
             print("train state is for epoch %s, not %d: cold-starting"
@@ -672,7 +704,48 @@ class Trainer:
             state["target_params"] = (
                 host_target if host_target is not None
                 else self._to_host(self.target_params))
-        write_atomic(train_state_path(), state)
+        self.last_state_digest = write_atomic(
+            train_state_path(), state,
+            checksum=self.checkpoint_checksum)
+
+    def _maybe_emergency_save(self):
+        """SIGTERM grace window: the handler (Learner._preempt_save)
+        armed ``self.emergency`` and is waiting on it; land a
+        CONSISTENT mid-epoch checkpoint — current params as
+        ``latest.ckpt`` plus the matching optimizer train state — and
+        re-point the manifest at it as an emergency resume point.
+        Runs on the trainer thread between steps (the only thread that
+        may touch the donated device state).  Skipped (event still
+        set) when there is nothing resumable yet (no completed epoch:
+        the resume machinery keys on epoch >= 1) or when saving is not
+        this process's job (multihost replicas; collectives are unsafe
+        inside a grace window, so multihost relies on the boundary
+        checkpoint instead)."""
+        event = self.emergency
+        if event is None or event.is_set():
+            return
+        try:
+            if (self.optimizer is None or self.multihost
+                    or not self.primary or self.epoch < 1
+                    or self.steps <= 0):
+                return
+            params = self._to_host(self.params)
+            state = {"params": params, "steps": self.steps,
+                     "epoch": self.epoch}
+            os.makedirs(_models_dir(), exist_ok=True)
+            digest = write_atomic(latest_model_path(), state,
+                                  checksum=self.checkpoint_checksum)
+            self.save_train_state(self.epoch)
+            if self.manifest is not None:
+                self.manifest.commit(
+                    self.epoch, latest_model_path(), digest,
+                    self.steps,
+                    train_state_digest=self.last_state_digest,
+                    emergency=True)
+            print(f"emergency checkpoint landed (epoch {self.epoch}, "
+                  f"step {self.steps})")
+        finally:
+            event.set()
 
     def _to_host(self, tree):
         """Host numpy copy of a device pytree.  Leaves that shard
@@ -803,6 +876,7 @@ class Trainer:
         while batch_cnt == 0 or not self.update_flag:
             if self.shutdown_flag:
                 return None
+            self._maybe_emergency_save()
             if cap and batch_cnt >= cap:
                 time.sleep(0.01)
                 continue
@@ -828,6 +902,7 @@ class Trainer:
         while batch_cnt == 0 or not self.update_flag:
             if self.shutdown_flag:
                 return None
+            self._maybe_emergency_save()
             with self.timers.section("ingest"):
                 # drain arrivals even when idling at the cap, so the
                 # pending queue can't overflow and shed episodes
@@ -1101,6 +1176,7 @@ class Trainer:
                 while replay.size < self.args["minimum_episodes"]:
                     if self.shutdown_flag:
                         return
+                    self._maybe_emergency_save()
                     replay.ingest()
                     if replay.size and replay.size >= replay.capacity:
                         print(f"device replay ring ({replay.capacity})"
@@ -1114,6 +1190,7 @@ class Trainer:
                 while len(self.episodes) < self.args["minimum_episodes"]:
                     if self.shutdown_flag:
                         return
+                    self._maybe_emergency_save()
                     time.sleep(1)
                 if self.optimizer is not None:
                     self.batcher.run()
@@ -1131,6 +1208,9 @@ class Trainer:
                     break
                 self.update_flag = False
                 while not self.shutdown_flag:
+                    # a SIGTERM can land while the learner thread is
+                    # busy (it will never drain this queue mid-handler)
+                    self._maybe_emergency_save()
                     try:
                         self.update_queue.put(
                             (model, self.steps), timeout=0.3)
@@ -1230,6 +1310,12 @@ class Learner:
     max_policy_lag = 0
     episodes_rejected_stale = 0
     _rejected_epoch = 0
+    wal = None
+    manifest = None
+    episodes_replayed = 0
+    checkpoint_checksum = True
+    _kill_switch = None
+    _resume = None
 
     def __init__(self, args, net=None, remote=False):
         from .config import Config
@@ -1246,7 +1332,10 @@ class Learner:
         telemetry.configure_from_args(
             self.args, role="learner",
             primary=jax.process_index() == 0)
-        telemetry.install_signal_dump()
+        # SIGTERM = preemption notice: durable state first (emergency
+        # checkpoint + WAL seal inside the grace window), THEN the
+        # flight-recorder dump and exit
+        telemetry.install_signal_dump(pre_dump=self._preempt_save)
         self._run_t0 = time.monotonic()
         self._epoch_t = self._run_t0
         self._policy_lags = []        # episode lags consumed this epoch
@@ -1272,6 +1361,24 @@ class Learner:
         self.multihost = jax.process_count() > 1
         self.primary = jax.process_index() == 0
 
+        # durability: resolve restart_epoch ("auto" or an explicit
+        # epoch whose file may be corrupt) against the checkpoint
+        # manifest BEFORE anything reads it — downstream consumers
+        # (trainer restore, worker merged args) see the resolved int
+        self.manifest = CheckpointManifest(_models_dir())
+        self.checkpoint_checksum = bool(
+            self.args.get("checkpoint_checksum", True))
+        self._resume = resolve_restart(
+            _models_dir(), self.args.get("restart_epoch", 0))
+        self.args["restart_epoch"] = self._resume.epoch
+        # the manifest-recorded digest of the train state that PAIRS
+        # with the resumed params (runtime key, not config): the
+        # trainer's restore proves the single train_state.ckpt on
+        # disk is that exact file before trusting it — an epoch tag
+        # alone cannot, because an emergency save reuses its epoch
+        self.args["_resume_state_digest"] = \
+            self._resume.train_state_digest
+
         self.model_epoch = self.args["restart_epoch"]
         self.model = self._initial_model(net)
 
@@ -1296,9 +1403,36 @@ class Learner:
                 self.args.get("heartbeat_timeout", 30.0) or 30.0))
         self._last_sweep = 0.0
         self.trainer = Trainer(self.args, self.model)
+        self.trainer.manifest = self.manifest if self.primary else None
         self.replay = ReplayBuffer(
             self.trainer.episodes, self.args["maximum_episodes"])
         self.metrics_path = self.args.get("metrics_path") or ""
+        # episode WAL: admitted episodes are logged at intake so a
+        # restarted learner replays its staged backlog instead of
+        # re-generating it (durability.EpisodeWAL); primary only — the
+        # WAL lives in the checkpoint dir this process owns
+        self.wal = None
+        self.episodes_replayed = 0
+        self._wal_seen = set()
+        if self.args.get("wal_enabled", True) and self.primary:
+            self.wal = EpisodeWAL(
+                os.path.join(_models_dir(), "wal"),
+                segment_bytes=int(
+                    self.args.get("wal_segment_mb", 8) or 8) << 20,
+                flush_interval=float(
+                    self.args.get("wal_flush_interval", 1.0)))
+            if self._resume.epoch > 0:
+                self._replay_wal()
+        # durability chaos: a scheduled SIGKILL of this process
+        # mid-epoch (the preemption drill the layer above must absorb)
+        from .resilience import ChaosConfig, LearnerKillSwitch
+
+        chaos_cfg = ChaosConfig.from_config(self.args.get("chaos") or {})
+        self._kill_switch = None
+        if chaos_cfg.learner_kill_enabled:
+            self._kill_switch = LearnerKillSwitch(
+                chaos_cfg,
+                os.path.join(_models_dir(), "chaos_learner_killed"))
         # stall watchdog: the server loop and the communicator's
         # reader/writer threads beat once per pass; a loop silent past
         # max_stall_seconds is a counted stall_event with a stack dump
@@ -1330,16 +1464,96 @@ class Learner:
     def _status_snapshot(self):
         """Live JSON for the status endpoint: fleet + telemetry + the
         latest per-epoch metrics record.  Read-only by construction."""
-        return {
+        snap = {
             "epoch": self.model_epoch,
             "episodes_received": self.episodes_received,
             "episodes_rejected_stale": self.episodes_rejected_stale,
+            "episodes_replayed": self.episodes_replayed,
             "connections": self.worker.connection_count(),
             "time_sec": round(time.monotonic() - self._run_t0, 3),
             "fleet": self.fleet.snapshot(),
             "telemetry": telemetry.stats(),
             "last_record": self._last_record,
         }
+        if self.wal is not None:
+            snap["wal"] = self.wal.stats()
+        return snap
+
+    # -- durability ---------------------------------------------------
+    def _wal_keep_episodes(self):
+        return (int(self.args.get("wal_keep_episodes", 0) or 0)
+                or self.args["maximum_episodes"])
+
+    def _replay_wal(self):
+        """Restore the staged backlog from the episode WAL (resume
+        path, before any thread starts).  Replayed episodes refill the
+        replay store — device ring or host deque — but do NOT tick
+        ``episodes_received``: epoch cadence tracks fresh arrivals,
+        and the replayed window's epochs were already recorded by the
+        previous incarnation.  The staleness budget still applies —
+        resuming is not a license to train on hopeless data."""
+        from collections import deque as _deque
+
+        keep = self._wal_keep_episodes()
+        with telemetry.trace_span("wal.replay"):
+            restored = _deque(maxlen=keep)
+            scanned = stale = 0
+            for _seq, episode in self.wal.replay(self._wal_seen):
+                scanned += 1
+                if (self.max_policy_lag > 0
+                        and self._episode_lag(episode)
+                        > self.max_policy_lag):
+                    stale += 1
+                    continue
+                restored.append(episode)
+            restored = list(restored)
+            if self.trainer.device_replay is not None:
+                # straight into the ring on this (pre-trainer) thread
+                self.episodes_replayed = \
+                    self.trainer.device_replay.warm_start(restored)
+            else:
+                self.replay.extend(restored)
+                self.episodes_replayed = len(restored)
+        if scanned:
+            print(f"wal: replayed {self.episodes_replayed} of "
+                  f"{scanned} logged episode(s) into the backlog"
+                  + (f" ({stale} past the staleness budget)"
+                     if stale else ""))
+
+    def _preempt_save(self):  # pragma: no cover - exercised by SIGTERM
+        """SIGTERM pre-dump hook (telemetry.install_signal_dump):
+        durable state inside the grace window, in rescue order — seal
+        the WAL (cheap, this thread owns it), ask the trainer thread
+        for an emergency checkpoint with a deadline, then tear the
+        local fleet down so orphans don't fight the relaunch for
+        cores.  Runs on the main (server) thread; everything here must
+        bound its own wait."""
+        print("SIGTERM: preemption grace window — sealing WAL and "
+              "requesting an emergency checkpoint")
+        if self.wal is not None:
+            try:
+                self.wal.seal()
+            except Exception as exc:
+                # broad on purpose: the signal can land mid-roll (file
+                # just closed => ValueError, not OSError), and a failed
+                # seal must cost the seal, never the emergency
+                # checkpoint and fleet teardown behind it
+                print(f"WARNING: WAL seal failed ({exc!r})")
+        grace = float(self.args.get("preempt_grace_seconds", 5.0) or 0.0)
+        trainer = getattr(self, "trainer", None)
+        if (grace > 0 and trainer is not None and self.primary
+                and not self.multihost):
+            event = threading.Event()
+            trainer.emergency = event
+            if not event.wait(grace):
+                print("WARNING: emergency checkpoint did not land "
+                      f"inside the {grace:.1f}s grace window; resume "
+                      "falls back to the last epoch boundary")
+        if self.worker is not None:
+            try:
+                self.worker.terminate_fleet()
+            except Exception as exc:  # teardown must not block the exit
+                print(f"WARNING: fleet teardown failed ({exc!r})")
 
     def _initial_model(self, net):
         if net is not None:
@@ -1351,8 +1565,15 @@ class Learner:
             obs = self.env.observation(self.env.players()[0])
             model.init_params(obs, seed=self.args["seed"])
         if self.model_epoch > 0:
-            with open(model_path(self.model_epoch), "rb") as f:
-                model.params = pickle.load(f)["params"]
+            # the resolved resume point names the exact file (an
+            # emergency save resumes from latest.ckpt, not the epoch
+            # file) and already verified it; read_verified re-checks at
+            # load so a race with pruning fails loudly, not weirdly
+            src = (self._resume.model_file
+                   if self._resume is not None
+                   and self._resume.model_file
+                   else model_path(self.model_epoch))
+            model.params = read_verified(src)["params"]
         return model
 
     # -- checkpointing ----------------------------------------------
@@ -1368,6 +1589,7 @@ class Learner:
             return
         keep_every = int(self.args.get("checkpoint_keep_every", 0) or 0)
         boundary = self.model_epoch - keep_last + 1  # prune below this
+        removed = []
         for epoch in range(getattr(self, "_pruned_below", 1), boundary):
             if keep_every > 0 and epoch % keep_every == 0:
                 continue
@@ -1375,8 +1597,13 @@ class Learner:
                 os.remove(model_path(epoch))
             except OSError:
                 pass  # already pruned (or an epoch that never saved)
+            removed.append(epoch)
         self._pruned_below = max(getattr(self, "_pruned_below", 1),
                                  boundary)
+        if removed and self.manifest is not None:
+            # retention prunes the index too: a manifest entry whose
+            # file is gone would just be noise in the fallback scan
+            self.manifest.forget(removed)
 
     def update_model(self, model, steps):
         print("updated model(%d)" % steps)
@@ -1393,9 +1620,24 @@ class Learner:
         os.makedirs(_models_dir(), exist_ok=True)
         state = {"params": model.params, "steps": steps,
                  "epoch": self.model_epoch}
-        write_atomic(model_path(self.model_epoch), state)
-        write_atomic(latest_model_path(), state)
+        digest = write_atomic(model_path(self.model_epoch), state,
+                              checksum=self.checkpoint_checksum)
+        write_atomic(latest_model_path(), state,
+                     checksum=self.checkpoint_checksum)
+        # the manifest is the COMMIT POINT: the epoch exists (for
+        # auto-resume and for fallback ordering) once this lands; the
+        # trainer stamped the matching train-state digest just before
+        if self.manifest is not None:
+            self.manifest.commit(
+                self.model_epoch, model_path(self.model_epoch),
+                digest, steps,
+                train_state_digest=self.trainer.last_state_digest)
         self._prune_checkpoints()
+        if self.wal is not None:
+            # checkpoint landed: the active WAL segment rolls (it is
+            # now a sealed, retirable unit) and segments the buffer no
+            # longer covers retire
+            self.wal.checkpoint_landed(self._wal_keep_episodes())
 
     # -- episode / result intake ------------------------------------
     def _episode_lag(self, episode):
@@ -1448,6 +1690,12 @@ class Learner:
         else:
             admitted = [(episode, None) for episode in arrived]
         kept = [episode for episode, _ in admitted]
+        if self.wal is not None and kept:
+            # write-ahead: an admitted episode reaches the log before
+            # any stats or buffer touch it, so a crash between here
+            # and the next checkpoint cannot lose the backlog
+            for episode in kept:
+                self.wal.append(episode)
         for episode, lag in admitted:
             self._note_intake(episode, lag)
             job = episode["args"]
@@ -1482,6 +1730,11 @@ class Learner:
             self.trainer.device_replay.offer(kept)
         else:
             self.replay.extend(kept)
+        if self._kill_switch is not None:
+            # durability chaos: the scheduled learner SIGKILL ticks on
+            # the intake clock (deterministically mid-window)
+            self._kill_switch.note(self.model_epoch,
+                                   self.episodes_received)
 
     def feed_results(self, results):
         for result in results:
@@ -1571,6 +1824,12 @@ class Learner:
         self._policy_lags = []
         record["episodes_rejected_stale"] = self._rejected_epoch
         self._rejected_epoch = 0
+        # durability telemetry: how many backlog episodes this run
+        # restored from the WAL (constant after startup; > 0 proves a
+        # resume re-entered a warm pipeline) and the log's live shape
+        record["episodes_replayed"] = self.episodes_replayed
+        if self.wal is not None:
+            record.update(self.wal.stats())
         self._report_win_rates(record)
         self._report_generation(record)
 
@@ -1630,6 +1889,11 @@ class Learner:
         now = time.monotonic()
         if now - self._last_sweep < 1.0:
             return
+        if self.wal is not None:
+            # idle-tail fsync: appends flush themselves on cadence,
+            # but buffered bytes from a quiet fleet must not sit
+            # unsynced forever
+            self.wal.maybe_flush(now)
         # the loop normally passes here every ~0.3-1s; a much larger
         # gap means THIS thread stalled (an epoch boundary inside
         # update(), checkpoint I/O) while peer messages queued unread
@@ -1851,6 +2115,8 @@ class Learner:
                 self.stall_watchdog.stop()
             if self.status is not None:
                 self.status.close()
+            if self.wal is not None:
+                self.wal.close()  # final fsync of the append tail
             telemetry.flush()  # ship the span-log tail before exit
 
 
@@ -1867,14 +2133,42 @@ def _maybe_init_distributed(args):
               f"/ {jax.device_count()} global devices")
 
 
-def train_main(args):
+def _train_local(args):
+    """One learner incarnation (the supervised-child entry point —
+    module-level so the spawn context can pickle it)."""
     _maybe_init_distributed(args)
     prepare_env(args["env_args"])
     learner = Learner(args=args)
     learner.run()
 
 
-def train_server_main(args):
+def _train_remote(args):
     _maybe_init_distributed(args)
     learner = Learner(args=args, remote=True)
     learner.run()
+
+
+def _maybe_supervised(args, target):
+    """``supervise_learner: true`` runs the learner as a guarded child
+    process: a crash or preemption relaunches it with ``restart_epoch:
+    auto`` behind the fleet's backoff/circuit-breaker policy
+    (resilience.guardian.LearnerGuard), so recovery needs no operator.
+    Returns True when the guard ran (and has already finished)."""
+    if not (args.get("train_args") or {}).get("supervise_learner"):
+        return False
+    from .resilience.guardian import LearnerGuard
+
+    code = LearnerGuard.from_args(target, args).run()
+    if code:
+        raise SystemExit(code)
+    return True
+
+
+def train_main(args):
+    if not _maybe_supervised(args, _train_local):
+        _train_local(args)
+
+
+def train_server_main(args):
+    if not _maybe_supervised(args, _train_remote):
+        _train_remote(args)

@@ -9,7 +9,13 @@ Three pillars (docs/large_scale_training.md "Fault tolerance"):
     per-gather last-seen / episode-rate / staleness bookkeeping behind
     the ``fleet_size`` / ``respawns`` / ``heartbeat_misses`` metrics.
   * :mod:`.chaos` — fault injection for tests: kill children at
-    configured rates/points, delay/drop/truncate control-plane frames.
+    configured rates/points, delay/drop/truncate control-plane frames,
+    and SIGKILL the learner itself (:class:`LearnerKillSwitch`).
+  * :mod:`.guardian` — the same supervision policy applied to the
+    LEARNER process: :class:`LearnerGuard` relaunches a crashed
+    learner with ``restart_epoch: auto`` behind a backoff schedule and
+    circuit breaker, completing the durability story of
+    handyrl_tpu.durability.
 
 Everything here is plain-Python process plumbing: no jax, no device
 state.  The data plane (XLA collectives inside jitted programs) has its
@@ -19,7 +25,13 @@ heartbeat and the job restarts from the last checkpoint
 gathers, episode intake) survive the same churn without a restart.
 """
 
-from .chaos import ChaosConfig, ChaosConnection, ChaosMonkey
+from .chaos import (
+    ChaosConfig,
+    ChaosConnection,
+    ChaosMonkey,
+    LearnerKillSwitch,
+)
+from .guardian import LearnerGuard
 from .health import FleetRegistry
 from .supervisor import BackoffPolicy, SlotState, Supervisor
 
@@ -29,6 +41,8 @@ __all__ = [
     "ChaosConnection",
     "ChaosMonkey",
     "FleetRegistry",
+    "LearnerGuard",
+    "LearnerKillSwitch",
     "SlotState",
     "Supervisor",
 ]

@@ -15,8 +15,10 @@ All randomness flows through one injectable RNG (``seed`` in the
 config), so chaos tests are seedable and non-flaky.
 """
 
+import os
 import pickle
 import random
+import signal
 import struct
 import time
 from dataclasses import dataclass, fields
@@ -47,6 +49,14 @@ class ChaosConfig:
     surge_respawn_hold: float = 0.0   # seconds respawns stay held after it
     surge_hold_uploads: float = 0.0   # seconds gathers sit on their upload
     #                                   backlog after seeing the surge epoch
+    # -- scheduled LEARNER kill (durability chaos): a hard SIGKILL of
+    # the learner process itself mid-epoch — the preemption the
+    # manifest/WAL/auto-resume machinery exists to survive.  Fires
+    # exactly once per run directory (a marker file under models/
+    # guards relaunches, so the supervised resume is not re-killed)
+    learner_kill_epoch: int = 0   # learner epoch that arms the kill; 0 = off
+    learner_kill_after_episodes: int = 1  # episodes received past the armed
+    #                                       epoch before the SIGKILL lands
     seed: int = 0                 # seeds the shared chaos RNG
 
     @classmethod
@@ -64,7 +74,8 @@ class ChaosConfig:
                 raise ValueError(f"chaos.{name} must be in [0, 1]")
         for name in ("kill_after", "frame_delay", "surge_respawn_hold",
                      "surge_hold_uploads", "max_kills", "surge_epoch",
-                     "surge_kills"):
+                     "surge_kills", "learner_kill_epoch",
+                     "learner_kill_after_episodes"):
             if getattr(cfg, name) < 0:
                 raise ValueError(f"chaos.{name} must be >= 0")
         total = (cfg.frame_drop_prob + cfg.frame_truncate_prob
@@ -90,6 +101,10 @@ class ChaosConfig:
     @property
     def surges_enabled(self) -> bool:
         return self.surge_epoch > 0
+
+    @property
+    def learner_kill_enabled(self) -> bool:
+        return self.learner_kill_epoch > 0
 
 
 class ChaosMonkey:
@@ -167,6 +182,57 @@ class ChaosMonkey:
                 index, reason=f"chaos surge at epoch {self.epoch}")
         if cfg.surge_respawn_hold > 0:
             supervisor.hold_respawns(cfg.surge_respawn_hold, now=now)
+        return True
+
+
+class LearnerKillSwitch:
+    """Schedules a hard SIGKILL of the LEARNER process mid-epoch.
+
+    The durability counterpart of :class:`ChaosMonkey`: where the
+    monkey preempts actors, the kill switch preempts the learner host
+    itself — no cleanup, no signal handler, exactly an eviction.  The
+    learner ticks :meth:`note` from its intake path; the kill lands
+    ``learner_kill_after_episodes`` arrivals after the noted epoch
+    reaches ``learner_kill_epoch``, which is deterministically
+    MID-window (between two checkpoints), the state the WAL exists to
+    recover.  A marker file (fsync'd before the kill) makes the switch
+    once-per-run-directory, so a supervised relaunch resumes instead
+    of being re-killed at the same epoch.  ``kill`` is injectable for
+    unit tests."""
+
+    def __init__(self, cfg: ChaosConfig, marker_path: str,
+                 kill: Optional[Callable[[], None]] = None):
+        self.cfg = cfg
+        self.marker_path = marker_path
+        self._kill = kill if kill is not None else self._sigkill_self
+        self._kill_at: Optional[int] = None
+        self.armed = (cfg.learner_kill_enabled
+                      and not os.path.exists(marker_path))
+
+    @staticmethod
+    def _sigkill_self():  # pragma: no cover - exercised by the e2e
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    def note(self, epoch: int, episodes_received: int) -> bool:
+        """Intake tick; returns True when the kill fired (test fakes
+        only — the real kill never returns)."""
+        if not self.armed or epoch < self.cfg.learner_kill_epoch:
+            return False
+        if self._kill_at is None:
+            self._kill_at = (episodes_received
+                             + self.cfg.learner_kill_after_episodes)
+        if episodes_received < self._kill_at:
+            return False
+        self.armed = False
+        os.makedirs(os.path.dirname(self.marker_path), exist_ok=True)
+        with open(self.marker_path, "w") as f:
+            f.write(f"epoch {epoch} after {episodes_received} episodes\n")
+            f.flush()
+            os.fsync(f.fileno())
+        print(f"CHAOS: SIGKILL of the learner at epoch {epoch} "
+              f"({episodes_received} episodes received) — durability "
+              "drill, resume should recover")
+        self._kill()
         return True
 
 

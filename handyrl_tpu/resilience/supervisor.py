@@ -56,6 +56,33 @@ class BackoffPolicy:
         return raw * (1.0 + self.jitter * self.rng.random())
 
 
+class FailureWindow:
+    """Windowed failure counter behind every circuit breaker here —
+    the actor Supervisor's per-slot breaker and the LearnerGuard's
+    relaunch breaker share THIS definition, so their semantics cannot
+    drift: failures older than ``window`` seconds age out, and the
+    breaker trips when the live count EXCEEDS ``max_failures``.  That
+    makes 0 the STRICTEST setting (trip on the first failure), never
+    "unlimited"."""
+
+    __slots__ = ("max_failures", "window", "times")
+
+    def __init__(self, max_failures: int, window: float):
+        self.max_failures = int(max_failures)
+        self.window = float(window)
+        self.times: List[float] = []
+
+    def record(self, now: float) -> bool:
+        """Note one failure at ``now``; True when the breaker trips."""
+        self.times.append(now)
+        cutoff = now - self.window
+        self.times = [t for t in self.times if t >= cutoff]
+        return len(self.times) > self.max_failures
+
+    def __len__(self) -> int:
+        return len(self.times)
+
+
 class SlotState(enum.Enum):
     RUNNING = "running"
     BACKOFF = "backoff"   # child gone; respawn scheduled at slot.due
@@ -66,11 +93,11 @@ class SlotState(enum.Enum):
 class _Slot:
     __slots__ = ("index", "child", "state", "failures", "respawns", "due")
 
-    def __init__(self, index: int):
+    def __init__(self, index: int, failures: FailureWindow):
         self.index = index
         self.child = None
         self.state = SlotState.BACKOFF  # spawns on the first poll
-        self.failures: List[float] = []  # recent failure times (window)
+        self.failures = failures        # this slot's breaker window
         self.respawns = 0
         self.due = 0.0
 
@@ -107,7 +134,9 @@ class Supervisor:
         # mid-run clean exit (all workers crashed) should respawn.
         self.treat_clean_exit_as_drain = bool(treat_clean_exit_as_drain)
         self._slots: Dict[int, _Slot] = {
-            i: _Slot(i) for i in range(num_slots)}
+            i: _Slot(i, FailureWindow(self.max_respawns,
+                                      self.failure_window))
+            for i in range(num_slots)}
         self._lock = threading.Lock()
         self.stopped = False
         self._hold_until = 0.0  # respawns paused until this clock time
@@ -220,13 +249,9 @@ class Supervisor:
 
     # -- the state machine -------------------------------------------
     def _record_failure(self, slot: _Slot, now: float):
-        slot.failures.append(now)
-        cutoff = now - self.failure_window
-        slot.failures = [t for t in slot.failures if t >= cutoff]
-        # max_respawns == 0 is the STRICTEST breaker (dead on first
-        # failure, no respawns), not "unlimited" — matching the
-        # documented "more than this many failures" semantics
-        if len(slot.failures) > self.max_respawns:
+        # the trip rule (incl. "max_respawns == 0 is the STRICTEST
+        # breaker") lives in FailureWindow, shared with LearnerGuard
+        if slot.failures.record(now):
             slot.state = SlotState.DEAD
             slot.child = None
             print(f"supervisor: slot {slot.index} marked dead after "

@@ -306,6 +306,30 @@ class DeviceReplay:
                 self._append_run(run)
                 del cols[:len(run)]
 
+    def warm_start(self, episodes):
+        """Restore a replayed backlog (durability WAL) straight into
+        the ring on the CALLER's thread, bypassing the bounded
+        ``pending`` handoff (whose shed-oldest cap exists for a live
+        stalled trainer, not for a finite resume replay).  MUST run
+        before the trainer thread starts — same single-thread contract
+        as ``ingest``.  Returns the number of episodes staged."""
+        count = 0
+        chunk = []
+        for episode in episodes:
+            if episode is None:
+                continue
+            chunk.append(episode)
+            if len(chunk) >= 64:
+                self.offer(chunk)
+                self.ingest(max_episodes=len(chunk))
+                count += len(chunk)
+                chunk = []
+        if chunk:
+            self.offer(chunk)
+            self.ingest(max_episodes=len(chunk))
+            count += len(chunk)
+        return count
+
     # -- buffer management -------------------------------------------
 
     def _per_slot_bytes(self, col):

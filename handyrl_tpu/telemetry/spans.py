@@ -414,18 +414,32 @@ def crash_dump(where, exc):
     dump("crash")
 
 
-def install_signal_dump():
+def install_signal_dump(pre_dump=None):
     """Dump on SIGTERM — a preemption or chaos kill leaves its flight
     record behind.  Main-thread only (signal module restriction); the
     handler re-raises SystemExit so supervised children still exit
-    nonzero and ride the normal failure -> respawn path."""
-    if not _state.enabled:
+    nonzero and ride the normal failure -> respawn path.
+
+    ``pre_dump`` runs FIRST, inside the grace window and regardless of
+    whether telemetry is enabled: the learner hooks its emergency
+    checkpoint + WAL seal here (durable state outranks the post-mortem
+    record).  Exceptions from it are printed and swallowed — a failing
+    emergency save must not block the dump or the exit."""
+    if not _state.enabled and pre_dump is None:
         return False
 
     def _on_term(signum, frame):  # pragma: no cover - exercised live
-        add_event("sigterm")
-        flush()
-        dump("sigterm")
+        if pre_dump is not None:
+            try:
+                pre_dump()
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+        if _state.enabled:
+            add_event("sigterm")
+            flush()
+            dump("sigterm")
         sys.exit(1)
 
     try:

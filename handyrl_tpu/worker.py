@@ -597,6 +597,16 @@ class WorkerCluster(QueueCommunicator):
             stats.update(self.supervisor.stats())
         return stats
 
+    def terminate_fleet(self):
+        """Preemption teardown (SIGTERM grace window): kill every
+        gather child NOW instead of draining.  A dying learner must
+        not leave an orphan fleet behind to compete with its own
+        supervised relaunch for host cores — the relaunch spawns a
+        fresh fleet and the WAL already holds the backlog the orphans
+        would have delivered."""
+        if self.supervisor is not None:
+            self.supervisor.terminate_all()
+
     def shutdown(self):
         self.begin_drain()
         super().shutdown()
@@ -620,6 +630,12 @@ class WorkerServer(QueueCommunicator):
         machine-side supervisors), so there is no monkey to tick; the
         gather-side surge hold still works remotely — it triggers off
         the model ids in the job stream, not this call."""
+
+    def terminate_fleet(self):
+        """Remote gathers belong to their machines' supervisors: a
+        preempted learner just leaves, the severed sockets fail their
+        round trips, and the machine-side session resume (PR 3) brings
+        them back against the relaunched learner."""
 
     def _admit(self, conn):
         """Entry handshake: reserve an id block, reply merged config."""

@@ -899,8 +899,13 @@ def test_chaos_gather_kill_training_completes(tmp_path, monkeypatch):
     assert len(records) == 3
     final = records[-1]
     assert final["respawns"] >= 1
-    # the fleet recovered to full strength (1 gather for 2 workers)
-    assert final["fleet_size"] == 1
+    # the fleet recovered to full strength (1 gather for 2 workers).
+    # Monotone state, not fleet_size-at-a-stamp: the respawned gather
+    # may re-register between epoch stamps under CPU contention, but a
+    # non-dead slot + a completed run IS the recovery (and peak_size
+    # latches at sweep time, so the registry provably saw the fleet)
+    assert learner.worker.supervisor.dead_count() == 0
+    assert learner.fleet.peak_size == 1
     assert final["heartbeat_misses"] >= 0
     assert os.path.exists("models/3.ckpt")
 
@@ -1035,14 +1040,17 @@ def test_chaos_surge_lag_spike_absorbed(tmp_path, monkeypatch):
     assert any("is_clip_frac" in r for r in records)
     assert any("target_net_age" in r for r in records)
     # fleet recovered after the held respawn: the supervisor respawned
-    # the surge victim (no slot circuit-broken, so capacity is back at
-    # 2), and the registry saw the whole fleet at some epoch stamp.
-    # Deliberately NOT records[-1]["fleet_size"] == 2 — on a loaded box
-    # the respawned gather's worker processes can still be booting when
-    # the learner races through the drained-backlog epochs, so its
-    # re-registration may land after the final stamp; the supervisor's
-    # slot states are the ground truth for recovery either way
+    # the surge victim and no slot circuit-broke, so capacity is back
+    # at 2.  Deliberately NO fleet_size-at-a-stamp assertion — neither
+    # records[-1] nor max-over-records: under CPU contention the
+    # respawned gather (or even the second gather at startup) can
+    # register between epoch stamps, and a single-snapshot assert
+    # flakes (seen once on this 1-core host).  The recovery proofs are
+    # MONOTONE state instead: the registry's peak_size latches at
+    # sweep time (~1 Hz, after dead-peer reconciliation — strictly
+    # more observation points than the per-epoch stamps), and the
+    # supervisor's slot states are the capacity ground truth
     assert learner.worker.supervisor.dead_count() == 0
-    assert max(r["fleet_size"] for r in records) == 2
+    assert learner.fleet.peak_size == 2
     assert records[-1]["respawns"] >= 1
     assert os.path.exists("models/8.ckpt")

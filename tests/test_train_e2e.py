@@ -142,9 +142,27 @@ def test_local_training_two_epochs(tmp_path, monkeypatch):
     assert os.path.exists("models/latest.ckpt")
 
     with open("models/latest.ckpt", "rb") as f:
-        state = pickle.load(f)
+        state = pickle.load(f)  # checksum footer trails the pickle
     assert state["epoch"] == 2
     assert state["steps"] > 0
+
+    # durability ran live under the default config: every checkpoint
+    # is checksummed and indexed by the manifest (the auto-resume
+    # source of truth), and the episode WAL logged the whole intake
+    from handyrl_tpu.durability import CheckpointManifest, verify_file
+
+    manifest = CheckpointManifest("models")
+    entries = manifest.load()["entries"]
+    assert sorted(entries) == ["1", "2"]
+    for epoch, entry in entries.items():
+        assert verify_file(f"models/{epoch}.ckpt", entry["digest"])
+    assert manifest.load()["latest"]["epoch"] == 2
+    assert verify_file("models/train_state.ckpt")
+    for record in records:
+        # a fresh run replays nothing; the WAL grows with intake
+        assert record["episodes_replayed"] == 0
+        assert record["wal_appended"] > 0
+    assert os.path.isdir("models/wal")
 
     # the saved snapshot round-trips into a working model
     from handyrl_tpu.envs.tictactoe import Environment as TicTacToe
