@@ -519,6 +519,125 @@ def durability_main(steps=12, eps_per_step=2):
     }))
 
 
+def pipeline_train_child(mode, epochs=3):
+    """One short REAL-STACK local training (TicTacToe, spawned workers,
+    device replay) with the pipelined dataflow on or off; emits one
+    JSON line of e2e numbers parsed from its metrics.jsonl.
+
+    The update budget is capped per epoch so the learner cannot spin
+    updates while starved: steps/s then measures how fast the actor
+    feed lets the learner cycle epochs — the end-to-end number the
+    pipeline exists to move — and `batch_wait` reports the per-epoch
+    feed starvation alongside it."""
+    import shutil
+    import tempfile
+
+    work = tempfile.mkdtemp(prefix=f"bench_pipe_{mode}_")
+    cwd = os.getcwd()
+    os.chdir(work)
+    try:
+        args = {
+            "env_args": {"env": "TicTacToe"},
+            "train_args": {
+                "turn_based_training": True, "observation": False,
+                "gamma": 0.8, "forward_steps": 8, "burn_in_steps": 0,
+                "compress_steps": 4, "entropy_regularization": 0.1,
+                "entropy_regularization_decay": 0.1,
+                "update_episodes": 60, "batch_size": 64,
+                "minimum_episodes": 40, "maximum_episodes": 400,
+                "epochs": epochs, "num_batchers": 1, "eval_rate": 0.05,
+                "updates_per_epoch": 40,
+                "worker": {"num_parallel": 2}, "lambda": 0.7,
+                "policy_target": "VTRACE", "value_target": "VTRACE",
+                "seed": 3, "metrics_path": "metrics.jsonl",
+                "telemetry": False,  # measure the dataflow, not spans
+                "pipeline": {"mode": mode},
+            },
+            "worker_args": {"num_parallel": 2, "server_address": ""},
+        }
+        from handyrl_tpu.learner import Learner
+
+        learner = Learner(args)
+        learner.run()
+        with open("metrics.jsonl") as f:
+            recs = [json.loads(line) for line in f if line.strip()]
+    finally:
+        os.chdir(cwd)
+        shutil.rmtree(work, ignore_errors=True)
+
+    dt = recs[-1]["time_sec"] - recs[0]["time_sec"]
+    steps = recs[-1]["steps"] - recs[0]["steps"]
+    post = recs[1:]  # the first window pays compile + worker bring-up
+    out = {
+        "mode": mode,
+        "steps_per_sec_e2e": round(steps / dt, 2) if dt > 0 else None,
+        "eps_per_sec_e2e": round(
+            60.0 * (len(recs) - 1) / dt, 2) if dt > 0 else None,
+        "batch_wait_sec": round(
+            sum(r.get("batch_wait_sec", 0.0) for r in post) / len(post),
+            4),
+        "epoch_wall_sec": round(
+            sum(r["epoch_wall_sec"] for r in post) / len(post), 3),
+    }
+    if mode == "on":
+        served = [r for r in recs if r.get("infer_batches", 0) > 0]
+        out["infer_batch_size_mean"] = round(sum(
+            r["infer_batch_size_mean"] for r in served)
+            / len(served), 2) if served else None
+        out["infer_queue_wait_sec"] = round(sum(
+            r["infer_queue_wait_sec"] for r in served)
+            / len(served), 6) if served else None
+        out["shm_ring_full_count"] = recs[-1].get("shm_ring_full_count")
+        out["infer_respawns"] = recs[-1].get("infer_respawns")
+    print(json.dumps(out))
+    sys.stdout.flush()
+    os._exit(0)  # skip non-daemonic gather joins (intake_child idiom)
+
+
+def pipeline_main(rounds=3, epochs=3):
+    """Pipeline variant (one JSON line, like main): the REAL worker/
+    learner stack with pipelined inference + shm trajectories vs the
+    legacy per-worker path, INTERLEAVED pairwise per round and ratioed
+    within rounds — the same discipline as `--durability` (this host
+    swings far more between trial blocks than either path's margin)."""
+    legacy, piped, ratios, waits_l, waits_p = [], [], [], [], []
+    extras = {}
+    for _ in range(rounds):
+        off = _run_child("--pipeline-child", timeout=900,
+                         extra=["off", str(epochs)])
+        on = _run_child("--pipeline-child", timeout=900,
+                        extra=["on", str(epochs)])
+        if off.get("steps_per_sec_e2e") and on.get("steps_per_sec_e2e"):
+            legacy.append(off["steps_per_sec_e2e"])
+            piped.append(on["steps_per_sec_e2e"])
+            ratios.append(on["steps_per_sec_e2e"]
+                          / off["steps_per_sec_e2e"])
+            waits_l.append(off["batch_wait_sec"])
+            waits_p.append(on["batch_wait_sec"])
+            for k in ("infer_batch_size_mean", "infer_queue_wait_sec",
+                      "shm_ring_full_count", "infer_respawns"):
+                if on.get(k) is not None:
+                    extras.setdefault(k, []).append(on[k])
+    if not ratios:
+        print(json.dumps({"metric": "pipeline_e2e_speedup",
+                          "error": "no complete rounds"}))
+        return
+    print(json.dumps({
+        "metric": "pipeline_e2e_speedup",
+        "value": round(_median(ratios), 3),
+        "unit": ("pipelined / legacy e2e learner steps/s ratio "
+                 "(TicTacToe real stack, 2 workers, "
+                 f"median of {len(ratios)} interleaved rounds)"),
+        "learner_steps_per_sec_e2e_pipelined": round(_median(piped), 2),
+        "learner_steps_per_sec_e2e_legacy": round(_median(legacy), 2),
+        "e2e_batch_wait_sec_pipelined": round(_median(waits_p), 4),
+        "e2e_batch_wait_sec_legacy": round(_median(waits_l), 4),
+        **{k: _median(v) for k, v in extras.items()},
+        "rounds": {"pipelined": piped, "legacy": legacy,
+                   "ratios": [round(r, 3) for r in ratios]},
+    }))
+
+
 def measure_width_sweep(seed, widths=(32, 64, 128, 256),
                         batch_size=BATCH):
     """Steps/s + MFU vs GeeseNet width at the flagship batch: settles
@@ -565,21 +684,21 @@ def setup_device_replay(seed, batch_size, compute_dtype, steps=40,
     batch, and updates in ONE jit fed three host scalars (the
     production ``device_replay: auto`` learner path).
 
-    Returns (trial, profile, ingest_eps, ingest_batched_eps):
-    ``trial()`` times ``steps`` fused update steps and may be called
-    repeatedly (interleaved trials).  ``ingest_eps`` is the legacy
-    one-episode-per-dispatch ``_append`` rate; ``ingest_batched_eps``
-    is the PRODUCTION intake chain — ``offer()`` + ``ingest()``
-    draining ``flood_mult * len(episodes)`` pre-canned wire episodes
-    through the consecutive-slot ``_append_run`` batched writes
-    (decompress + pad + one device dispatch per 8 episodes), ring
-    wraps included."""
+    Returns (trial, profile, ingest_eps): ``trial()`` times ``steps``
+    fused update steps and may be called repeatedly (interleaved
+    trials).  ``ingest_eps`` is the intake chain — ``offer()`` +
+    ``ingest()`` draining ``flood_mult * len(episodes)`` pre-canned
+    wire episodes through the consecutive-slot ``_append_run`` batched
+    writes (decompress + pad + one device dispatch per 8 episodes),
+    ring wraps included.  (Batched is the ONLY ingest path now — the
+    legacy one-episode-per-dispatch rate it used to report measured a
+    code path that no longer exists.)"""
     import jax
     import jax.numpy as jnp
 
     from handyrl_tpu.ops.losses import LossConfig
     from handyrl_tpu.ops.update import make_optimizer
-    from handyrl_tpu.staging import DeviceReplay, _decompress_episode
+    from handyrl_tpu.staging import DeviceReplay
     from handyrl_tpu.utils.profiling import SectionTimers
 
     model, _, cfg, episodes = seed
@@ -593,11 +712,9 @@ def setup_device_replay(seed, batch_size, compute_dtype, steps=40,
     }
     replay = DeviceReplay(rcfg, capacity=len(episodes) + 2,
                           max_bytes=4 << 30)
-    t0 = time.perf_counter()
-    for ep in episodes:
-        replay._append(_decompress_episode(ep))
+    replay.offer(episodes)  # warm: sizes buffers, compiles the append
+    replay.ingest(max_episodes=len(episodes))
     jax.block_until_ready(replay.buffers)
-    ingest_eps = len(episodes) / (time.perf_counter() - t0)
 
     # production intake on the warmed ring (append jit compiled, ring
     # at capacity so every write wraps like a steady-state run)
@@ -608,7 +725,7 @@ def setup_device_replay(seed, batch_size, compute_dtype, steps=40,
     while replay.pending:
         replay.ingest(max_episodes=64)
     jax.block_until_ready(replay.buffers)
-    ingest_batched_eps = len(flood) / (time.perf_counter() - t0)
+    ingest_eps = len(flood) / (time.perf_counter() - t0)
 
     loss_cfg = LossConfig.from_config(cfg)
     optimizer = make_optimizer(1e-3)
@@ -651,7 +768,7 @@ def setup_device_replay(seed, batch_size, compute_dtype, steps=40,
 
     return (trial, lambda: {n: v["sec"]
                             for n, v in timers.snapshot().items()},
-            ingest_eps, ingest_batched_eps)
+            ingest_eps)
 
 
 # ---------------------------------------------------------------------
@@ -970,11 +1087,11 @@ def main():
                                          timed_iters=0)
     prefetch_sps = measure_prefetch(seed, BATCH, "bfloat16")
     try:
-        dr_trial, dr_prof_fn, dr_ingest, dr_ingest_batched = \
+        dr_trial, dr_prof_fn, dr_ingest = \
             setup_device_replay(seed4, BATCH, "bfloat16")
     except Exception as exc:  # one broken section must not kill the report
         print(f"device-replay bench failed: {exc!r}", file=sys.stderr)
-        dr_trial, dr_ingest, dr_ingest_batched = None, None, None
+        dr_trial, dr_ingest = None, None
         err = repr(exc)  # 'except ... as' unbinds at block exit
         dr_prof_fn = lambda: {"error": err}  # noqa: E731
     e2e_trial, e2e_stop, e2e_prof_fn = setup_pipeline(
@@ -1037,11 +1154,11 @@ def main():
             round(dr_sps, 2) if dr_sps is not None else None,
         # the draw is fused in-jit since late r4: no sample section
         "device_replay_update_sec": dr_prof.get("update"),
+        # the batched offer()+ingest() chain — the ONLY ingest path
+        # (the legacy per-episode dispatch it was once compared
+        # against is deleted)
         "device_replay_ingest_eps_per_sec":
             round(dr_ingest, 1) if dr_ingest is not None else None,
-        "device_replay_ingest_batched_eps_per_sec":
-            round(dr_ingest_batched, 1)
-            if dr_ingest_batched is not None else None,
         "learner_steps_per_sec_b64_bf16": round(sps64_bf16, 2),
         "learner_steps_per_sec_b1024_bf16": round(sps1024_bf16, 2),
         "reference_steps_per_sec_b256_torch_cpu": ref256,
@@ -1147,5 +1264,13 @@ if __name__ == "__main__":
     elif "--durability" in sys.argv:
         tail = [a for a in sys.argv[2:] if a.isdigit()]
         durability_main(steps=int(tail[0]) if tail else 12)
+    elif "--pipeline-child" in sys.argv:
+        tail = sys.argv[sys.argv.index("--pipeline-child") + 1:]
+        mode = tail[0] if tail else "on"
+        pipeline_train_child(
+            mode, epochs=int(tail[1]) if len(tail) > 1 else 3)
+    elif "--pipeline" in sys.argv:
+        tail = [a for a in sys.argv[2:] if a.isdigit()]
+        pipeline_main(rounds=int(tail[0]) if tail else 3)
     else:
         main()

@@ -373,6 +373,17 @@ class CommAnalysis:
                 for expr in payloads:
                     if isinstance(expr, ast.Name) and expr.id in params:
                         sm.payload_params.add(expr.id)
+                    # ``send_recv(conn, (verb, payload))`` with verb a
+                    # parameter: a tuple built at a send wrapper's
+                    # payload slot makes ITS head a verb-head param —
+                    # the Worker._ship shape (ship-or-spill helpers
+                    # that route between the shm transport and the
+                    # control plane)
+                    if isinstance(expr, ast.Tuple) and expr.elts:
+                        head = expr.elts[0]
+                        if isinstance(head, ast.Name) \
+                                and head.id in params:
+                            sm.verb_params.add(head.id)
                 for expr in verb_heads:
                     if isinstance(expr, ast.Name) and expr.id in params:
                         sm.verb_params.add(expr.id)

@@ -43,13 +43,26 @@ from .utils.tree import tree_map, tree_stack, stack_time_player
 ILLEGAL = np.float32(1e32)
 
 
+def load_block(blob):
+    """Moment block bytes -> list of moment dicts.
+
+    Two wire formats share the episode schema, told apart by stream
+    magic (no flag to thread through the columnar cache): the legacy
+    control-plane format is bz2-compressed pickle (``BZh`` magic); the
+    shm trajectory path ships raw pickle blocks (``\\x80`` protocol-2+
+    opcode) — shared-memory bandwidth is free, so it skips the bz2 CPU
+    cost on both ends (``pipeline.compress`` re-enables it)."""
+    if blob[:2] == b"BZ":
+        blob = bz2.decompress(blob)
+    return pickle.loads(blob)
+
+
 def decompress_moments(ep):
-    """Inflate an episode's bz2 moment blocks and slice to [start, end).
+    """Inflate an episode's moment blocks and slice to [start, end).
 
     Uncached: the production batch path consumes the columnar cache
     below; this raw-moment view serves tests and tooling."""
-    moments = [m for blob in ep["moment"]
-               for m in pickle.loads(bz2.decompress(blob))]
+    moments = [m for blob in ep["moment"] for m in load_block(blob)]
     return moments[ep["start"] - ep["base"]: ep["end"] - ep["base"]]
 
 
@@ -184,7 +197,7 @@ def _columnar_block(blob):
     if hit is not None:
         _COL_CACHE.move_to_end(blob)
         return hit[0]
-    col = _build_columnar(pickle.loads(bz2.decompress(blob)))
+    col = _build_columnar(load_block(blob))
     nbytes = _nbytes_tree(col)
     if nbytes <= _COL_CACHE_MAX_BYTES // 4:
         _COL_CACHE[blob] = (col, nbytes)

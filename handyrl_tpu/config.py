@@ -222,6 +222,15 @@ class TrainConfig:
     # kill_after, max_kills, frame_drop_prob, frame_truncate_prob,
     # frame_delay_prob, frame_delay, seed); empty = off
     chaos: Dict[str, Any] = field(default_factory=dict)
+    # -- pipelined rollout dataflow (handyrl_tpu.pipeline) --
+    # Sebulba-style split: `mode: on` replaces per-worker CPU inference
+    # with the learner's batched inference service and ships finished
+    # trajectories over the zero-copy shared-memory transport (the
+    # framed control plane keeps control verbs only).  Keys (validated
+    # through PipelineConfig.from_config): mode, batch_window,
+    # max_batch, ring_slots, slot_bytes, traj_slots, traj_slot_mb,
+    # fallback, fallback_after, compress.  Empty = off (legacy path)
+    pipeline: Dict[str, Any] = field(default_factory=dict)
     # -- off-policy robustness (IMPACT, arXiv:1912.00167) --
     # "standard" (default): importance ratios against the live learner
     # policy, score-function policy loss — the reference behavior.
@@ -327,6 +336,11 @@ class TrainConfig:
         from .resilience.chaos import ChaosConfig
 
         ChaosConfig.from_config(self.chaos)
+        # pipeline keys likewise validate through the dataclass the
+        # inference service and worker-side client run with
+        from .pipeline.config import PipelineConfig
+
+        PipelineConfig.from_config(self.pipeline)
         if self.device_replay not in ("auto", "on", "off"):
             raise ValueError(
                 f"unknown device_replay {self.device_replay!r}")

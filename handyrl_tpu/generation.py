@@ -53,16 +53,26 @@ def fill_discounted_returns(moments, players, gamma):
             returns[p] = acc[i]
 
 
-def pack_episode(moments, outcome, job_args, compress_steps):
-    """Wire format: job args + step count + outcome + bz2 moment blocks."""
+def pack_episode(moments, outcome, job_args, compress_steps,
+                 compress=True):
+    """Wire format: job args + step count + outcome + moment blocks.
+
+    Blocks are bz2-compressed pickle on the control plane (the legacy
+    socket transport pays per byte); the shm trajectory path passes
+    ``compress=False`` for raw pickle blocks — shared-memory bandwidth
+    is free and the bz2 CPU cost is the actor loop's.  Consumers sniff
+    the stream magic per block (batch.load_block), so the two formats
+    mix freely in one replay buffer."""
+    def block(lo):
+        blob = pickle.dumps(moments[lo: lo + compress_steps])
+        return bz2.compress(blob) if compress else blob
+
     return {
         "args": job_args,
         "steps": len(moments),
         "outcome": outcome,
-        "moment": [
-            bz2.compress(pickle.dumps(moments[lo: lo + compress_steps]))
-            for lo in range(0, len(moments), compress_steps)
-        ],
+        "moment": [block(lo)
+                   for lo in range(0, len(moments), compress_steps)],
     }
 
 
@@ -173,7 +183,9 @@ class Generator:
         fill_discounted_returns(
             moments, self.env.players(), self.args["gamma"])
         return pack_episode(moments, self.env.outcome(), args,
-                            self.args["compress_steps"])
+                            self.args["compress_steps"],
+                            compress=self.args.get(
+                                "episode_compress", True))
 
     def execute(self, models, args):
         episode = self.generate(models, args)
@@ -381,7 +393,15 @@ class RolloutPool:
         import jax
 
         obs = jax.tree.unflatten(self._obs_treedef, self._obs_leaves)
-        outputs = self.model.inference_batch(obs, self.hidden)
+        if self.hidden is None and getattr(
+                self.model, "supports_rows", False):
+            # served inference (pipeline.ServedModel): ship only the
+            # rows that observed this step — the N-row staging buffer
+            # stays host-side and outputs scatter back N-shaped
+            idx = np.fromiter((r for r, _, _ in rows), dtype=np.int64)
+            outputs = self.model.inference_batch(obs, None, rows=idx)
+        else:
+            outputs = self.model.inference_batch(obs, self.hidden)
         new_hidden = outputs.pop("hidden", None)
         if self.hidden is not None and new_hidden is not None:
             idx = np.fromiter((r for r, _, _ in rows), dtype=np.int64)
@@ -403,7 +423,8 @@ class RolloutPool:
                 slot.moments, env.players(), self.args["gamma"])
             episode = pack_episode(
                 slot.moments, env.outcome(), slot.job,
-                self.args["compress_steps"])
+                self.args["compress_steps"],
+                compress=self.args.get("episode_compress", True))
             # the pool may have swapped to a newer snapshot mid-episode
             # (IS-exact — recorded probs are the acting policy's), so
             # the honest generation-stats label is the epoch that

@@ -1316,6 +1316,12 @@ class Learner:
     checkpoint_checksum = True
     _kill_switch = None
     _resume = None
+    infer_service = None
+    _infer_respawns = 0
+    _infer_respawn_at = 0.0
+    _infer_disabled = False
+    _infer_kill_epoch = 0
+    _infer_killed = False
 
     def __init__(self, args, net=None, remote=False):
         from .config import Config
@@ -1433,6 +1439,33 @@ class Learner:
             self._kill_switch = LearnerKillSwitch(
                 chaos_cfg,
                 os.path.join(_models_dir(), "chaos_learner_killed"))
+        # pipelined rollout dataflow (handyrl_tpu.pipeline): the
+        # batched inference service answers every local worker's
+        # per-step forward and receives finished trajectories over the
+        # shm transport.  One service per learner PROCESS (each
+        # multi-host replica serves its own workers); remote mode has
+        # no service — shared memory does not cross machines, so
+        # remote handshakes are refused and those workers keep local
+        # inference.  Service death is a supervised fault: the server
+        # loop respawns it behind the same backoff + windowed breaker
+        # the actor fleet uses, and workers bridge the gap on their
+        # local fallback path
+        from .pipeline import InferenceService, PipelineConfig
+
+        self._pipeline_cfg = PipelineConfig.from_config(
+            self.args.get("pipeline") or {})
+        # (the off/zero states ride the class-level defaults above,
+        # the same pattern as _kill_switch/_resume)
+        self._infer_kill_epoch = chaos_cfg.infer_kill_epoch
+        if self._pipeline_cfg.enabled and not remote:
+            from .resilience.supervisor import FailureWindow
+
+            self._infer_window = FailureWindow(
+                int(self.args.get("max_respawns", 5)), 60.0)
+            self.infer_service = InferenceService(
+                self.model, self._pipeline_cfg,
+                epoch=self.model_epoch)
+            self.infer_service.start()
         # stall watchdog: the server loop and the communicator's
         # reader/writer threads beat once per pass; a loop silent past
         # max_stall_seconds is a counted stall_event with a stack dump
@@ -1477,6 +1510,11 @@ class Learner:
         }
         if self.wal is not None:
             snap["wal"] = self.wal.stats()
+        if self.infer_service is not None:
+            snap["pipeline"] = {
+                **self.infer_service.stats(),
+                "respawns": self._infer_respawns,
+            }
         return snap
 
     # -- durability ---------------------------------------------------
@@ -1613,6 +1651,21 @@ class Learner:
         # (no-op without an armed monkey; see WorkerCluster.note_epoch)
         if self.worker is not None:
             self.worker.note_epoch(self.model_epoch)
+        if self.infer_service is not None:
+            # hot-swap the serving snapshot BEFORE jobs labeled with
+            # the new epoch go out: the service adopts it between
+            # batches, so no in-flight request is dropped and workers'
+            # epoch-pinned wrappers stay served across the boundary
+            self.infer_service.set_model(model, self.model_epoch)
+            if (self._infer_kill_epoch > 0 and not self._infer_killed
+                    and self.model_epoch >= self._infer_kill_epoch):
+                # pipeline chaos: the service dies without a parting
+                # heartbeat — workers must bridge on local fallback
+                # until the supervised respawn below brings it back
+                self._infer_killed = True
+                print(f"CHAOS: killing the inference service at epoch "
+                      f"{self.model_epoch}")
+                self.infer_service.inject_kill()
         if not self.primary:
             # replicas serve the in-memory snapshot to their own
             # workers; only process 0 writes the checkpoint dir
@@ -1847,6 +1900,12 @@ class Learner:
         record["steps"] = steps
         record.update(getattr(self.trainer, "last_metrics", {}))
         record.update(self._fleet_record())
+        if self.infer_service is not None:
+            # pipelined-inference telemetry (docs/observability.md):
+            # per-epoch batch-size distribution, mean batching-window
+            # wait, cumulative ring-full backpressure, and respawns
+            record.update(self.infer_service.epoch_stats())
+            record["infer_respawns"] = self._infer_respawns
         if self.stall_watchdog is not None:
             # control-plane wedges this epoch (server loop + reader/
             # writer threads silent past max_stall_seconds); steady
@@ -1943,6 +2002,65 @@ class Learner:
             print("WARNING: this process's entire gather fleet is "
                   "dead; training is starved of episodes")
 
+    # -- pipelined dataflow ------------------------------------------
+    def _on_shm(self, specs):
+        """The shm handshake (verb ``"shm"``): allocate rings + a
+        client slot per asking worker.  None refuses — pipeline off,
+        remote learner (no shared memory across machines), shutdown,
+        or a malformed spec — and the worker keeps local inference."""
+        replies = []
+        for spec in specs:
+            if (self.infer_service is None or self._infer_disabled
+                    or self.shutdown_flag or not isinstance(spec, dict)):
+                replies.append(None)
+                continue
+            try:
+                replies.append(self.infer_service.attach(spec))
+            except Exception as exc:  # a bad spec costs that worker
+                print(f"WARNING: shm attach failed ({exc!r}); "
+                      "the peer keeps local inference")
+                replies.append(None)
+        return replies
+
+    def _pipeline_tick(self):
+        """Once per server-loop pass: drain the shm trajectory rings
+        into episode intake, and supervise the service thread — a dead
+        service respawns behind backoff and the fleet's windowed
+        circuit breaker (workers bridge the gap on local fallback; a
+        breaker trip disables the pipeline for the rest of the run
+        instead of respawn-storming)."""
+        svc = self.infer_service
+        if svc is None:
+            return
+        episodes = svc.drain_trajectories(max_episodes=512)
+        if episodes:
+            with telemetry.trace_span("intake.shm",
+                                      episodes=len(episodes)):
+                self.feed_episodes(episodes)
+        if svc.alive or self._infer_disabled or self.shutdown_flag:
+            return
+        now = time.monotonic()
+        if self._infer_respawn_at == 0.0:
+            if self._infer_window.record(now):
+                self._infer_disabled = True
+                print("ERROR: the inference service keeps dying "
+                      "(circuit breaker tripped); pipelined inference "
+                      "disabled for this run — workers continue on "
+                      "local CPU inference")
+                return
+            delay = float(self.args.get("respawn_backoff", 0.5) or 0.5)
+            self._infer_respawn_at = now + delay
+            print(f"WARNING: inference service died; respawning in "
+                  f"{delay:.1f}s (workers fall back to local "
+                  f"inference meanwhile)")
+        elif now >= self._infer_respawn_at:
+            self._infer_respawn_at = 0.0
+            self._infer_respawns += 1
+            svc.set_model(self.model, self.model_epoch)
+            svc.respawn()
+            print("inference service respawned "
+                  f"(incarnation {svc.board.generation})")
+
     # -- server loop -------------------------------------------------
     def _on_beat(self, beats):
         # liveness bookkeeping happened in the server loop (the
@@ -1973,6 +2091,7 @@ class Learner:
             "result": self._on_result,
             "model": self._on_model,
             "beat": self._on_beat,
+            "shm": self._on_shm,
         }
         next_epoch_at = (self.args["minimum_episodes"]
                          + self.args["update_episodes"])
@@ -1985,6 +2104,10 @@ class Learner:
             except queue.Empty:
                 conn = None  # epoch checks below still run on idle
             self._sweep_fleet()
+            # shm trajectory intake + inference-service supervision
+            # run every pass, so pipelined episodes tick the same
+            # epoch cadence as control-plane arrivals below
+            self._pipeline_tick()
 
             if conn is not None:
                 self.fleet.observe(conn, verb, payload)
@@ -2115,6 +2238,10 @@ class Learner:
                 self.stall_watchdog.stop()
             if self.status is not None:
                 self.status.close()
+            if self.infer_service is not None:
+                # workers are gone (shutdown drained them): unmap and
+                # unlink every ring this learner created
+                self.infer_service.close()
             if self.wal is not None:
                 self.wal.close()  # final fsync of the append tail
             telemetry.flush()  # ship the span-log tail before exit

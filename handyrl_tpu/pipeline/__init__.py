@@ -1,0 +1,29 @@
+"""handyrl_tpu.pipeline — Sebulba-style pipelined rollout dataflow.
+
+The actor/learner split, re-split (Podracer, arXiv:2104.06272): env
+stepping stays in CPU worker processes, but inference for every worker
+runs as ONE batched, jitted forward in the learner-side
+:class:`~.service.InferenceService` (wait-or-timeout request batching,
+snapshot hot-swap), and finished trajectories travel over the
+zero-copy shared-memory transport of :mod:`.shm` instead of
+bz2-pickle frames on the socket control plane — which keeps carrying
+control verbs (jobs, model fetches, heartbeats, the ``"shm"``
+handshake itself) only.
+
+Public surface:
+
+  * :class:`PipelineConfig` — validated ``pipeline.*`` config;
+  * :class:`ShmRing` / :class:`ShmBoard` — the SPSC seqlock transport;
+  * :class:`InferenceService` — the learner-side batched server;
+  * :class:`PipelineClient` / :class:`ServedModel` /
+    :func:`attach_pipeline` — the worker-side endpoint.
+"""
+
+from .config import PipelineConfig  # noqa: F401
+from .shm import ShmBoard, ShmRing  # noqa: F401
+from .service import InferenceService  # noqa: F401
+from .client import (  # noqa: F401
+    PipelineClient,
+    ServedModel,
+    attach_pipeline,
+)

@@ -1,0 +1,316 @@
+"""Worker-side pipeline client: served inference + trajectory shipping.
+
+``attach_pipeline`` runs the shm handshake over the framed control
+plane (verb ``"shm"``, forwarded through the gather): the worker sends
+its observation schema, the learner's inference service allocates the
+three rings and replies with an attach descriptor — or ``None`` when
+the pipeline is off, the learner is remote (shared memory does not
+cross machines), or the learner is shutting down, in which case the
+worker simply keeps the legacy local-inference path.
+
+``ServedModel`` is the integration seam: it wraps a locally-resolved
+model with the same ``inference``/``inference_batch``/``init_hidden``
+duck type the rollout engines already consume, so the RolloutPool and
+the sequential Generator run unchanged — their "model" just happens to
+answer from the learner's batched forward.  The wrapped local model
+stays warm as the **fallback**: a stale service heartbeat, a full
+ring, or a reply deadline sends the call to the worker's own
+CPU-jitted forward (``pipeline.fallback: local``) instead of stalling
+the env loop; when the board beats again (service respawn), the next
+call returns to the served path on its own.
+
+Recurrent models are never wrapped: their hidden state lives on the
+worker, and shipping it per step would drown the transport — they keep
+the local path (documented in docs/large_scale_training.md).
+"""
+
+import time
+
+from .shm import ShmBoard, ShmRing, dumps, loads_view, pack_request
+
+
+def build_obs_spec(env, rows_max):
+    """The handshake payload: leaf schema + a structure example of this
+    env's observation, plus the worst-case row count (lockstep
+    episodes x players)."""
+    import jax
+    import numpy as np
+
+    env.reset()
+    obs = env.observation(env.players()[0])
+    leaves = [np.asarray(a) for a in jax.tree.leaves(obs)]
+    return {
+        "leaves": [(tuple(a.shape), str(a.dtype)) for a in leaves],
+        "example": obs,
+        "rows_max": int(rows_max),
+    }
+
+
+def attach_pipeline(conn, env, args):
+    """Run the shm handshake; returns a PipelineClient or None (legacy
+    path).  Any failure here is a degraded start, never a crash — the
+    worker trains fine without the pipeline."""
+    from .config import PipelineConfig
+
+    try:
+        cfg = PipelineConfig.from_config(args.get("pipeline") or {})
+    except ValueError:
+        return None
+    if not cfg.enabled:
+        return None
+    from ..connection import send_recv
+
+    lockstep = int(args.get("lockstep_episodes", 1) or 1)
+    rows_max = max(1, lockstep) * len(env.players())
+    spec = build_obs_spec(env, rows_max)
+    try:
+        desc = send_recv(conn, ("shm", spec))
+    except (ConnectionError, EOFError, OSError):
+        return None
+    if not desc:
+        return None  # refused: remote learner / pipeline off / draining
+    try:
+        return PipelineClient(desc, cfg)
+    except (FileNotFoundError, OSError, ValueError) as exc:
+        print(f"pipeline attach failed ({exc!r}); "
+              "falling back to local inference")
+        return None
+
+
+class PipelineClient:
+    """One worker's mapped endpoint of the shm transport."""
+
+    def __init__(self, desc, cfg, clock=time.monotonic,
+                 sleep=time.sleep):
+        self.cfg = cfg
+        self.clock = clock
+        self.sleep = sleep
+        self.client_id = desc["client"]
+        self.board = ShmBoard.attach(desc["board"])
+        self.req = ShmRing.attach(**desc["req"])
+        self.rsp = ShmRing.attach(**desc["rsp"])
+        self.traj = ShmRing.attach(**desc["traj"])
+        self.seq = 0
+        self.fallbacks = 0        # served calls answered locally
+        self.episodes_shipped = 0
+        self.episodes_spilled = 0  # fell back to the control plane
+        self._served = {}          # (id(model), epoch) -> ServedModel
+        # self-degradation: a service that BEATS but never lands our
+        # replies (reply slot too small for the output frame, or this
+        # client was reaped by mistake) must not cost the env loop a
+        # full reply deadline per step forever — after a few
+        # consecutive reply timeouts this client stops trying until
+        # the service's next incarnation
+        self.degraded = False
+        self._timeouts = 0
+        self._degraded_gen = -1
+
+    DEGRADE_AFTER = 3  # consecutive reply timeouts before giving up
+
+    def healthy(self):
+        return self.board.age() < self.cfg.fallback_after
+
+    def usable(self):
+        """Healthy AND not self-degraded.  A new service incarnation
+        (respawn bumps the board generation) clears the degradation —
+        the fault may have died with the old incarnation."""
+        if self.degraded:
+            if self.board.generation == self._degraded_gen:
+                return False
+            self.degraded = False
+            self._timeouts = 0
+        return self.healthy()
+
+    def serving_epoch(self):
+        """The snapshot epoch the service currently holds — one shared-
+        memory read, no round trip.  Wrappers pinned to another epoch
+        skip the transport entirely (league/pinned-eval seats)."""
+        return self.board.epoch
+
+    def wrap(self, model, epoch):
+        """A stable ServedModel per underlying model instance (the
+        RolloutPool swaps models by identity, so the wrapper must be
+        as stable as what it wraps).  ``epoch`` pins the wrapper: it
+        is served only while the service holds that exact snapshot —
+        anything else answers locally, so pinned evaluation seats and
+        league opponents can never be fed a different policy's
+        actions."""
+        key = (id(model), int(epoch))
+        wrapper = self._served.get(key)
+        if wrapper is None or wrapper.local is not model:
+            wrapper = ServedModel(model, self, epoch)
+            self._served[key] = wrapper
+            while len(self._served) > 6:
+                self._served.pop(next(iter(self._served)))
+        return wrapper
+
+    # -- obs -> action round trip -------------------------------------
+    def request(self, leaves):
+        """Ship one batch of obs rows; block (bounded) for the reply.
+        Returns ``(epoch, outputs)`` — the snapshot epoch that actually
+        answered — or None when the caller must fall back locally
+        (counted)."""
+        import numpy as np
+
+        if not self.usable():
+            self.fallbacks += 1
+            return None
+        rows = int(leaves[0].shape[0])
+        self.seq += 1
+        parts = pack_request(
+            self.seq, rows,
+            [np.ascontiguousarray(a) for a in leaves])
+        if not self.req.push(parts):
+            self.fallbacks += 1
+            return None  # ring full / oversize: local fallback
+        deadline = self.clock() + max(
+            self.cfg.fallback_after, 4 * self.cfg.batch_window)
+        while True:
+            reply = self.rsp.pop(loads=loads_view)
+            if reply is not None:
+                seq, epoch, outputs = reply
+                if seq == self.seq:
+                    self._timeouts = 0
+                    return epoch, outputs
+                continue  # stale reply from an abandoned request
+            if not self.healthy():
+                self.fallbacks += 1
+                return None  # service died mid-request
+            if self.clock() > deadline:
+                # the service is beating but our reply never landed:
+                # count toward self-degradation so a systematic drop
+                # (oversize replies, a mistaken reap) costs a few
+                # steps, not one deadline per step forever
+                self.fallbacks += 1
+                self._timeouts += 1
+                if self._timeouts >= self.DEGRADE_AFTER:
+                    self.degraded = True
+                    self._degraded_gen = self.board.generation
+                    print("pipeline client: replies keep timing out "
+                          "with a live service; degrading to local "
+                          "inference until its next incarnation")
+                return None
+            self.sleep(1e-4)
+
+    # -- trajectory shipping ------------------------------------------
+    def push_episode(self, episode) -> bool:
+        """Write one finished episode into the trajectory ring.  False
+        (counted) = control-plane fallback: ring full, episode larger
+        than a slot, or service presumed gone."""
+        blob = dumps(episode)
+        if self.traj.push(blob):
+            self.episodes_shipped += 1
+            return True
+        self.episodes_spilled += 1
+        return False
+
+    def close(self):
+        self.board.close()
+        self.req.close()
+        self.rsp.close()
+        self.traj.close()
+
+
+class ServedModel:
+    """Model duck type whose forward runs on the inference service.
+
+    ``supports_rows`` lets the RolloutPool ship only the rows that
+    actually need inference this step (the N-row staging buffer stays
+    host-side); outputs scatter back into N-shaped arrays so the
+    pool's absolute-row indexing is untouched.
+    """
+
+    supports_rows = True
+
+    def __init__(self, model, client, epoch):
+        self.local = model
+        self.client = client
+        self.epoch = int(epoch)
+
+    # the cache/adoption paths inspect these on occasion
+    @property
+    def module(self):
+        return self.local.module
+
+    @property
+    def params(self):
+        return self.local.params
+
+    @property
+    def is_recurrent(self):
+        return self.local.is_recurrent
+
+    def init_hidden(self, batch_shape=None):
+        return self.local.init_hidden(batch_shape)
+
+    def _spin_until_healthy(self):
+        # pipeline.fallback: none — benchmark mode, wait out the gap.
+        # BOUNDED: a permanently-disabled service (circuit breaker
+        # tripped, board never beats again) must not wedge the fleet —
+        # after the bound the caller answers locally anyway
+        deadline = self.client.clock() + max(
+            60.0, 10 * self.client.cfg.fallback_after)
+        while (not self.client.usable()
+               and self.client.clock() < deadline):
+            self.client.sleep(1e-3)
+
+    def _served_rows(self, leaves):
+        """Rows -> outputs via the service, or None (answer locally).
+        The wrapper is epoch-pinned: a service holding any other
+        snapshot is skipped (one shm read) — pinned evaluation seats
+        and league opponents must never act on a different policy."""
+        if self.client.serving_epoch() != self.epoch:
+            return None
+        result = self.client.request(leaves)
+        if result is None and self.client.cfg.fallback == "none":
+            self._spin_until_healthy()
+            result = self.client.request(leaves)
+        if result is None:
+            return None
+        epoch, outputs = result
+        if epoch != self.epoch:
+            return None  # swapped mid-flight: the local copy answers
+        return outputs
+
+    def inference(self, obs, hidden=None):
+        """Single-state forward (sequential Generator / pinned eval
+        seats reach this): one-row served batch, batch dim stripped."""
+        import jax
+        import numpy as np
+
+        if hidden is not None:
+            return self.local.inference(obs, hidden)
+        leaves = [np.asarray(a)[None] for a in jax.tree.leaves(obs)]
+        outputs = self._served_rows(leaves)
+        if outputs is None:
+            return self.local.inference(obs, None)
+        return {k: np.asarray(v)[0] for k, v in outputs.items()}
+
+    def inference_batch(self, obs, hidden=None, rows=None):
+        """Batched forward via the service.  ``rows`` (optional int
+        array) selects the rows to compute; outputs come back N-shaped
+        with zeros elsewhere — callers only read the rows they asked
+        for (RolloutPool indexes by absolute row)."""
+        import jax
+        import numpy as np
+
+        if hidden is not None:
+            return self.local.inference_batch(obs, hidden)
+        leaves = [np.asarray(a) for a in jax.tree.leaves(obs)]
+        if rows is not None:
+            sel = [leaf[rows] for leaf in leaves]
+        else:
+            sel = leaves
+        outputs = self._served_rows(sel)
+        if outputs is None:
+            return self.local.inference_batch(obs, hidden)
+        if rows is None:
+            return outputs
+        n = leaves[0].shape[0]
+        full = {}
+        for k, v in outputs.items():
+            v = np.asarray(v)
+            buf = np.zeros((n,) + v.shape[1:], v.dtype)
+            buf[rows] = v
+            full[k] = buf
+        return full

@@ -1,0 +1,513 @@
+"""Batched inference service: the learner-side half of the pipeline.
+
+One server thread owns a snapshot of the model and answers obs->action
+requests from every attached rollout worker: requests accumulate
+across workers inside a **wait-or-timeout batching window**
+(``pipeline.batch_window`` seconds after the first pending request, or
+until ``pipeline.max_batch`` rows are staged, whichever first), then
+ONE jitted ``inference_batch`` forward covers all of them and replies
+scatter back over each worker's reply ring.  This replaces the
+per-worker ``ModelWrapper.inference`` hot path (Sebulba, Podracer
+arXiv:2104.06272; SEED-style centralized inference, IMPALA) — actor
+processes become env-stepping loops that enqueue observations and
+block on actions.
+
+Snapshot **hot swap**: the learner hands every new epoch's model to
+``set_model``; the loop adopts it between batches, re-pointing the
+compiled forward at the new params (the trace is weight-independent,
+so no recompile) — in-flight requests are never dropped, they are
+simply answered by whichever snapshot is installed when their batch
+dispatches (importance corrections stay exact: workers record the
+behavior probabilities the reply actually carried).
+
+Batch shapes bucket to powers of two (floor 8, ceiling ``max_batch``)
+so XLA compiles a handful of variants instead of one per request mix.
+
+Liveness is a heartbeat stamp on a shared ``ShmBoard``: workers watch
+its age and fall back to local CPU inference when the service goes
+silent (death is a supervised, chaos-injectable fault — the learner
+respawns the thread and workers return on their own once the beat
+resumes).
+
+Telemetry: every dispatch records an ``infer.batch`` span (rows,
+window wait), and ``epoch_stats`` reduces the epoch's dispatches into
+``infer_batch_size_{mean,p95}`` / ``infer_queue_wait_sec`` /
+``shm_ring_full_count`` for metrics.jsonl (docs/observability.md).
+"""
+
+import threading
+import time
+
+from .. import telemetry
+from .shm import (
+    ShmBoard,
+    ShmRing,
+    dumps,
+    loads_view,
+    unpack_request,
+)
+
+
+class _Client:
+    """One attached worker: its three rings + request schema."""
+
+    __slots__ = ("cid", "req", "rsp", "traj", "leaf_specs", "example",
+                 "rows_max", "treedef", "req_stuck_since",
+                 "traj_stuck_since", "last_seen", "drop_warned")
+
+    def __init__(self, cid, req, rsp, traj, leaf_specs, example,
+                 rows_max):
+        self.cid = cid
+        self.req = req
+        self.rsp = rsp
+        self.traj = traj
+        self.leaf_specs = [(tuple(s), str(d)) for s, d in leaf_specs]
+        self.example = example
+        self.rows_max = rows_max
+        self.treedef = None          # resolved lazily (jax import)
+        self.req_stuck_since = None  # torn-write reclaim bookkeeping
+        self.traj_stuck_since = None
+        self.last_seen = 0.0         # last request/trajectory activity
+        self.drop_warned = False     # reply-drop warning, once per client
+
+
+def _bucket(n, cap):
+    """Pad target for an n-row batch: next power of two, floor 8,
+    ceiling ``cap`` — a handful of compiled shapes total."""
+    b = 8
+    while b < n:
+        b <<= 1
+    return min(b, cap)
+
+
+class InferenceService:
+    """The batched inference server (one per learner process).
+
+    Thread contract: ``attach``/``set_model``/``inject_kill``/``stats``
+    may be called from the learner's server thread; the batching loop
+    runs on the service's own thread; ``drain_trajectories`` belongs to
+    the learner server thread (it is the trajectory rings' single
+    consumer).  ``clock``/``sleep`` are injectable so the batching
+    window is unit-testable without wall time.
+    """
+
+    TORN_GRACE = 30.0  # seconds a mid-write slot may stall before reclaim
+    # a client silent on BOTH rings this long is presumed dead (its
+    # worker crashed or degraded to pure-local) and its rings are
+    # reclaimed; a live worker that gets reaped by mistake degrades
+    # itself to local inference on the next reply timeout — degraded,
+    # never wrong
+    CLIENT_IDLE_REAP = 600.0
+    GRAVE_GRACE = 10.0  # close only after in-flight snapshots expire
+
+    def __init__(self, model, cfg, epoch=0, clock=time.monotonic,
+                 sleep=time.sleep):
+        self.cfg = cfg
+        self.clock = clock
+        self.sleep = sleep
+        self._lock = threading.Lock()
+        self._clients = {}
+        self._next_cid = 0
+        self._model = model
+        self._epoch = int(epoch)
+        self._pending_model = None
+        self.board = ShmBoard.create()
+        self._thread = None
+        self._stop = False
+        self._kill = False           # chaos: die WITHOUT a parting beat
+        # counters — epoch accumulators reset by epoch_stats()
+        self._batch_rows = []
+        self._queue_wait = 0.0
+        self._requests_epoch = 0
+        self._warm = []              # client ids awaiting a jit warmup
+        self.batches = 0             # cumulative dispatches
+        self.requests = 0            # cumulative request frames served
+        self.rows_served = 0         # cumulative obs rows answered
+        self.reclaimed = 0           # torn slots skipped (dead writers)
+        self.reply_drops = 0         # replies refused by a full/small ring
+        self.reaped = 0              # idle clients reclaimed
+        self._grave = []             # (deadline, client) pending close
+
+    # -- control-plane face (learner server thread) --------------------
+    def attach(self, spec):
+        """Allocate a client slot + rings for one worker's handshake
+        (verb ``"shm"``); returns the attach descriptor the worker
+        maps, or raises on a malformed spec (the learner's handler
+        answers None for refusals — remote peers, shutdown)."""
+        leaf_specs = spec["leaves"]
+        rows_max = max(1, int(spec.get("rows_max", 1)))
+        import numpy as np
+
+        row_bytes = sum(
+            int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+            for shape, dtype in leaf_specs)
+        need = 16 + 2 * rows_max * max(1, row_bytes)
+        slot = max(int(self.cfg.slot_bytes), need)
+        with self._lock:
+            cid = self._next_cid
+            self._next_cid += 1
+            client = _Client(
+                cid,
+                req=ShmRing.create(self.cfg.ring_slots, slot),
+                rsp=ShmRing.create(self.cfg.ring_slots, slot),
+                traj=ShmRing.create(self.cfg.traj_slots,
+                                    int(self.cfg.traj_slot_mb) << 20),
+                leaf_specs=leaf_specs,
+                example=spec["example"],
+                rows_max=rows_max,
+            )
+            client.last_seen = self.clock()
+            self._clients[cid] = client
+            # warm this schema's buckets from the SERVICE thread (the
+            # handshake/model-fetch slack), so the first real request
+            # is not the one paying the jit compile — a compile longer
+            # than fallback_after would bounce it to local fallback
+            self._warm.append(cid)
+        return {
+            "client": cid,
+            "board": self.board.name,
+            "req": client.req.descriptor(),
+            "rsp": client.rsp.descriptor(),
+            "traj": client.traj.descriptor(),
+        }
+
+    def set_model(self, model, epoch):
+        """Hot-swap the serving snapshot; adopted between batches, so
+        no in-flight request is ever dropped."""
+        with self._lock:
+            self._pending_model = (model, int(epoch))
+
+    def inject_kill(self):
+        """Chaos: the loop exits without a parting beat — exactly what
+        a SIGKILLed dedicated server process would look like to the
+        workers (stale board) and the learner (dead thread)."""
+        self._kill = True
+
+    @property
+    def alive(self):
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self):
+        self._kill = False
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="infer-service")
+        self._thread.start()
+
+    def respawn(self):
+        """Relaunch after a death: same rings, same clients — state
+        lives in shared memory, so workers resume on their own once
+        the beat returns (a fresh generation stamp says it's a new
+        incarnation)."""
+        self.board.bump_generation()
+        self.start()
+
+    def stop(self):
+        self._stop = True
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def close(self):
+        self.stop()
+        with self._lock:
+            clients = list(self._clients.values())
+            self._clients.clear()
+        for c in clients:
+            c.req.close()
+            c.rsp.close()
+            c.traj.close()
+        self.board.close()
+
+    # -- metrics -------------------------------------------------------
+    def ring_full_count(self):
+        """Cumulative push refusals across every ring of every client,
+        read straight from the shm headers — includes the counts the
+        WORKERS' producer sides maintained (req/traj rings), with no
+        control-plane reporting needed."""
+        total = 0
+        with self._lock:
+            clients = list(self._clients.values())
+        for c in clients:
+            total += (c.req.full_count + c.rsp.full_count
+                      + c.traj.full_count)
+        return total
+
+    def epoch_stats(self):
+        """Per-epoch reduction for metrics.jsonl; resets the epoch
+        accumulators.  Keys are the docs/observability.md contract."""
+        with self._lock:
+            rows = self._batch_rows
+            wait = self._queue_wait
+            requests = self._requests_epoch
+            self._batch_rows = []
+            self._queue_wait = 0.0
+            self._requests_epoch = 0
+        out = {
+            "infer_batches": len(rows),
+            "infer_requests": requests,
+            "shm_ring_full_count": self.ring_full_count(),
+        }
+        if rows:
+            srt = sorted(rows)
+            out["infer_batch_size_mean"] = round(
+                sum(rows) / len(rows), 2)
+            out["infer_batch_size_p95"] = srt[
+                min(len(srt) - 1, int(0.95 * len(srt)))]
+            out["infer_queue_wait_sec"] = round(wait / len(rows), 6)
+        return out
+
+    def stats(self):
+        """Cumulative snapshot (status endpoint)."""
+        with self._lock:
+            n = len(self._clients)
+        return {
+            "clients": n,
+            "epoch": self._epoch,
+            "alive": self.alive,
+            "generation": self.board.generation,
+            "batches": self.batches,
+            "requests": self.requests,
+            "rows_served": self.rows_served,
+            "shm_ring_full_count": self.ring_full_count(),
+            "torn_reclaimed": self.reclaimed,
+            "reply_drops": self.reply_drops,
+            "clients_reaped": self.reaped,
+        }
+
+    # -- trajectory intake (learner server thread) ---------------------
+    def drain_trajectories(self, max_episodes=512):
+        """Pop finished episodes off every client's trajectory ring —
+        the learner feeds them straight into episode intake.  This
+        thread is those rings' single consumer."""
+        episodes = []
+        now = self.clock()
+        with self._lock:
+            clients = list(self._clients.values())
+        for c in clients:
+            while len(episodes) < max_episodes:
+                ep = c.traj.pop(loads=loads_view)
+                if ep is None:
+                    c.traj_stuck_since = self._maybe_reclaim(
+                        c.traj, c.traj_stuck_since, now)
+                    break
+                c.traj_stuck_since = None
+                c.last_seen = now
+                episodes.append(ep)
+        return episodes
+
+    def _maybe_reclaim(self, ring, stuck_since, now):
+        """Mid-write slot watch: a slot odd-stamped for longer than
+        TORN_GRACE means its writer died mid-frame (a live writer
+        finishes in microseconds) — skip it so the ring flows again.
+        Returns the updated stuck-since stamp."""
+        if not ring.pending() or ring.readable():
+            return None
+        if stuck_since is None:
+            return now
+        if now - stuck_since >= self.TORN_GRACE:
+            if ring.skip_torn():
+                self.reclaimed += 1
+            return None
+        return stuck_since
+
+    # -- the batching loop --------------------------------------------
+    def _adopt_model(self):
+        with self._lock:
+            pending = self._pending_model
+            self._pending_model = None
+        if pending is None:
+            return
+        model, epoch = pending
+        prev = self._model
+        # keep the compiled forward across the swap (params are jit
+        # arguments, so the trace is weight-independent) — the same
+        # adoption trick the worker-side ModelCache uses
+        try:
+            if (prev is not None and hasattr(prev, "module")
+                    and prev.module == model.module):
+                model._jitted = prev._jitted
+        except Exception:
+            pass
+        self._model = model
+        self._epoch = epoch
+
+    def _obs_tree(self, client, leaves):
+        import jax
+
+        if client.treedef is None:
+            client.treedef = jax.tree.structure(client.example)
+        return jax.tree.unflatten(client.treedef, leaves)
+
+    def _collect(self, pending, now):
+        """One sweep over every request ring; appends (client, seq,
+        leaves, rows) tuples.  Returns rows collected this sweep."""
+        got = 0
+        with self._lock:
+            clients = list(self._clients.values())
+        for c in clients:
+            while True:
+                item = c.req.pop(
+                    loads=lambda v, c=c: unpack_request(v, c.leaf_specs))
+                if item is None:
+                    c.req_stuck_since = self._maybe_reclaim(
+                        c.req, c.req_stuck_since, now)
+                    break
+                c.req_stuck_since = None
+                c.last_seen = self.clock()
+                seq, rows, leaves = item
+                pending.append((c, seq, rows, leaves))
+                got += rows
+        return got
+
+    def step(self):
+        """One batching-window pass: collect, wait-or-timeout, forward,
+        reply.  Returns True when a batch dispatched (the loop idles
+        briefly otherwise).  Synchronous and clock-injected: unit
+        tests drive it directly, no thread."""
+        pending = []
+        total = self._collect(pending, self.clock())
+        if not pending:
+            return False
+        t_first = self.clock()
+        # wait-or-timeout: give batch-mates from other workers
+        # batch_window seconds to arrive, unless the batch is full
+        deadline = t_first + self.cfg.batch_window
+        while total < self.cfg.max_batch:
+            now = self.clock()
+            if now >= deadline:
+                break
+            self.sleep(min(2e-4, deadline - now))
+            total += self._collect(pending, self.clock())
+        self._dispatch(pending, total, self.clock() - t_first)
+        return True
+
+    def _dispatch(self, pending, total, waited):
+        import numpy as np
+
+        self._adopt_model()
+        model, epoch = self._model, self._epoch
+        # one forward per max_batch chunk (normally exactly one)
+        i = 0
+        while i < len(pending):
+            chunk, rows = [], 0
+            while i < len(pending) and (
+                    rows + pending[i][2] <= self.cfg.max_batch
+                    or not chunk):
+                chunk.append(pending[i])
+                rows += pending[i][2]
+                i += 1
+            t0 = telemetry.span_begin()
+            bucket = _bucket(rows, max(rows, self.cfg.max_batch))
+            leaves = [np.concatenate(parts, axis=0) for parts in zip(
+                *[leaves for _, _, _, leaves in chunk])]
+            if bucket > rows:
+                leaves = [np.concatenate(
+                    [leaf, np.zeros((bucket - rows,) + leaf.shape[1:],
+                                    leaf.dtype)], axis=0)
+                    for leaf in leaves]
+            obs = self._obs_tree(chunk[0][0], leaves)
+            outputs = model.inference_batch(obs, None)
+            outputs.pop("hidden", None)
+            lo = 0
+            for client, seq, n, _ in chunk:
+                part = {k: np.asarray(v[lo:lo + n])
+                        for k, v in outputs.items()}
+                lo += n
+                if not client.rsp.push(dumps((seq, epoch, part))):
+                    # full or too small for the OUTPUT pickle (reply
+                    # slots are sized from the obs schema): the worker
+                    # will time out, count it, and degrade itself to
+                    # local inference — say why, once per client
+                    self.reply_drops += 1
+                    if not client.drop_warned:
+                        client.drop_warned = True
+                        print(f"WARNING: inference reply to client "
+                              f"{client.cid} dropped (reply ring full "
+                              f"or slot smaller than the output "
+                              f"frame); that worker will degrade to "
+                              f"local inference")
+            self.batches += 1
+            self.requests += len(chunk)
+            self.rows_served += rows
+            with self._lock:
+                self._batch_rows.append(rows)
+                self._queue_wait += waited
+                self._requests_epoch += len(chunk)
+            telemetry.span_end("infer.batch", t0, rows=rows,
+                               wait=round(waited, 6), epoch=epoch)
+
+    def _warm_next(self):
+        """Compile the forward for one pending client's likely batch
+        buckets (min bucket + its lockstep rows_max) with zero
+        observations.  Runs on the service thread between batches."""
+        import numpy as np
+
+        with self._lock:
+            if not self._warm:
+                return False
+            # peek, don't pop: warm_pending must stay truthful while
+            # the compile below blocks this thread (and the beat) —
+            # popping first made "warmed" readable a compile-length
+            # early, and a request landing in that window died at the
+            # client's health deadline (found live, flaky test)
+            client = self._clients.get(self._warm[0])
+        try:
+            if client is not None:
+                self._adopt_model()
+                buckets = {_bucket(1, self.cfg.max_batch),
+                           _bucket(client.rows_max, self.cfg.max_batch)}
+                for rows in sorted(buckets):
+                    leaves = [np.zeros((rows,) + shape, dtype)
+                              for shape, dtype in client.leaf_specs]
+                    self._model.inference_batch(
+                        self._obs_tree(client, leaves), None)
+        finally:
+            with self._lock:
+                if self._warm:
+                    self._warm.pop(0)
+        return client is not None
+
+    def _reap_idle(self):
+        """Reclaim clients silent on both rings past CLIENT_IDLE_REAP
+        (their worker died or went fully local).  Two-phase: removal
+        from the live set now, ring close after GRAVE_GRACE — any
+        snapshot iteration taken before removal finishes long before
+        the grace expires, so no thread can touch a closing buffer."""
+        now = self.clock()
+        with self._lock:
+            dead = [cid for cid, c in self._clients.items()
+                    if now - c.last_seen > self.CLIENT_IDLE_REAP]
+            for cid in dead:
+                client = self._clients.pop(cid)
+                self._grave.append((now + self.GRAVE_GRACE, client))
+                self.reaped += 1
+                print(f"pipeline: reaped idle client {cid} "
+                      f"(silent {self.CLIENT_IDLE_REAP:.0f}s)")
+            ready = [c for due, c in self._grave if now >= due]
+            self._grave = [(due, c) for due, c in self._grave
+                           if now < due]
+        for client in ready:
+            client.req.close()
+            client.rsp.close()
+            client.traj.close()
+        return bool(dead or ready)
+
+    @property
+    def warm_pending(self):
+        with self._lock:
+            return len(self._warm)
+
+    def _loop(self):
+        self.board.beat(epoch=self._epoch)
+        while not self._stop:
+            if self._kill:
+                return  # chaos death: no parting beat, board goes stale
+            self._adopt_model()
+            worked = self.step()
+            if not worked:
+                worked = self._warm_next()
+            if not worked:
+                self._reap_idle()
+            self.board.beat(epoch=self._epoch)
+            if not worked:
+                self.sleep(5e-4)

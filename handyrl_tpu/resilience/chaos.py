@@ -57,6 +57,11 @@ class ChaosConfig:
     learner_kill_epoch: int = 0   # learner epoch that arms the kill; 0 = off
     learner_kill_after_episodes: int = 1  # episodes received past the armed
     #                                       epoch before the SIGKILL lands
+    # -- scheduled INFERENCE-SERVER kill (pipeline chaos): the batched
+    # inference service dies without a parting heartbeat when the
+    # learner epoch reaches this — workers must fall back to local CPU
+    # inference and the learner must respawn the service.  Fires once
+    infer_kill_epoch: int = 0     # learner epoch of the kill; 0 = off
     seed: int = 0                 # seeds the shared chaos RNG
 
     @classmethod
@@ -75,7 +80,8 @@ class ChaosConfig:
         for name in ("kill_after", "frame_delay", "surge_respawn_hold",
                      "surge_hold_uploads", "max_kills", "surge_epoch",
                      "surge_kills", "learner_kill_epoch",
-                     "learner_kill_after_episodes"):
+                     "learner_kill_after_episodes",
+                     "infer_kill_epoch"):
             if getattr(cfg, name) < 0:
                 raise ValueError(f"chaos.{name} must be >= 0")
         total = (cfg.frame_drop_prob + cfg.frame_truncate_prob
@@ -105,6 +111,10 @@ class ChaosConfig:
     @property
     def learner_kill_enabled(self) -> bool:
         return self.learner_kill_epoch > 0
+
+    @property
+    def infer_kill_enabled(self) -> bool:
+        return self.infer_kill_epoch > 0
 
 
 class ChaosMonkey:

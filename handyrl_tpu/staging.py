@@ -152,13 +152,12 @@ _PER_SLOT = ("outcome", "ep_len", "ep_total")
 
 
 def _decompress_episode(ep):
-    """Full-episode columnar arrays from the wire format (bz2 moment
-    blocks).  Runs once per episode at ingest."""
-    import bz2
-    import pickle
+    """Full-episode columnar arrays from the wire format (bz2 or raw
+    pickle moment blocks, magic-sniffed per block — see
+    batch.load_block).  Runs once per episode at ingest."""
+    from .batch import load_block
 
-    moments = [m for blob in ep["moment"]
-               for m in pickle.loads(bz2.decompress(blob))]
+    moments = [m for blob in ep["moment"] for m in load_block(blob)]
     col = _build_columnar(moments)
     col["outcome"] = np.asarray(
         [ep["outcome"][p] for p in col["players"]],
@@ -293,12 +292,18 @@ class DeviceReplay:
                 return
             cols = [_decompress_episode(ep) for ep in cols]
             done += len(cols)
+            # batched is the ONLY path: size/allocate/grow decisions
+            # are taken once over the whole run, then the run lands as
+            # one device scatter (the legacy per-episode `_append`
+            # dispatch measured ~12x slower and is gone)
+            need = max(len(c["turn_idx"]) for c in cols)
             if self.buffers is None:
-                self._append(cols.pop(0))  # sizes + allocates buffers
+                if need > self.t_max:
+                    self.t_max = _round_up(need)
+                self._init_buffers(cols[0])
+            elif need > self.t_max:
+                self._grow(_round_up(max(need, self.t_max * 2)))
             while cols:
-                if any(len(c["turn_idx"]) > self.t_max for c in cols):
-                    self._append(cols.pop(0))  # grows, then resume
-                    continue
                 # never more episodes than ring slots in one scatter:
                 # repeated slot indices would mix trajectories
                 # (undefined duplicate-index winner)
@@ -549,16 +554,6 @@ class DeviceReplay:
         self.size = min(self.size + k, self.capacity)
         self.episodes_seen += k
         self._state_dirty = True
-
-    def _append(self, col):
-        T = len(col["turn_idx"])
-        if self.buffers is None:
-            if T > self.t_max:
-                self.t_max = _round_up(T)
-            self._init_buffers(col)
-        if T > self.t_max:
-            self._grow(_round_up(max(T, self.t_max * 2)))
-        self._append_run([col])
 
     def _grow(self, new_t_max):
         """A longer episode than ever seen arrived: re-lay the ring

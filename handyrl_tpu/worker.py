@@ -145,6 +145,20 @@ class Worker:
         from .generation import Generator, RolloutPool
 
         self.env = make_env({**args["env"], "id": wid})
+        # pipelined dataflow (handyrl_tpu.pipeline): the shm handshake
+        # rides the control plane through the gather; None = legacy
+        # local inference (pipeline off, remote learner, or refusal)
+        from .pipeline import attach_pipeline
+
+        self.pipeline = attach_pipeline(conn, self.env, args)
+        if self.pipeline is not None:
+            print(f"worker {wid}: pipelined inference attached "
+                  f"(client {self.pipeline.client_id})")
+            if not self.pipeline.cfg.compress:
+                # episodes ride shared memory: skip the bz2 CPU cost
+                # (spilled episodes still interop — blocks are
+                # magic-sniffed at every consumer)
+                self.args = {**args, "episode_compress": False}
         self.models = ModelCache(conn, self.env)
         generator = Generator(self.env, self.args)
         evaluator = Evaluator(self.env, self.args)
@@ -168,14 +182,37 @@ class Worker:
     def _resolve(self, job):
         id_by_player = job.get("model_id", {})
         resolved = self.models.resolve(list(id_by_player.values()))
+        if self.pipeline is not None:
+            # epoch-pinned served wrappers: each snapshot's forward is
+            # answered by the inference service while it holds exactly
+            # that epoch, locally otherwise — so league/pinned-eval
+            # seats stay on their own policy by construction.  Only
+            # feed-forward nets wrap (recurrent hidden state lives on
+            # the worker; shipping it per step would drown the rings)
+            for mid, model in resolved.items():
+                if (mid > 0 and model is not None
+                        and hasattr(model, "module")
+                        and not getattr(model, "is_recurrent", False)):
+                    resolved[mid] = self.pipeline.wrap(model, mid)
         return {p: resolved[mid] for p, mid in id_by_player.items()}
+
+    def _ship(self, verb, payload):
+        """One finished payload upstream: episodes ride the shm
+        trajectory ring when the pipeline is attached (zero-copy, no
+        ack round trip); everything else — results, and episodes the
+        ring refuses (full/oversize) — takes the control plane."""
+        if (verb == "episode" and payload is not None
+                and self.pipeline is not None
+                and self.pipeline.push_episode(payload)):
+            return
+        with payload_trace(payload):
+            send_recv(self.conn, (verb, payload))
 
     def _run_job(self, job):
         models = self._resolve(job)
         runner, reply_verb = self.roles[job["role"]]
         payload = self._traced_run(runner, job, models)
-        with payload_trace(payload):
-            send_recv(self.conn, (reply_verb, payload))
+        self._ship(reply_verb, payload)
 
     @staticmethod
     def _traced_run(runner, job, models):
@@ -218,11 +255,9 @@ class Worker:
                     self._run_job(job)
                     continue
                 for verb, payload in pool.assign(job, self._resolve(job)):
-                    with payload_trace(payload):
-                        send_recv(self.conn, (verb, payload))
+                    self._ship(verb, payload)
             for verb, payload in pool.step():
-                with payload_trace(payload):
-                    send_recv(self.conn, (verb, payload))
+                self._ship(verb, payload)
 
     def _drain_pool(self):
         """Step the pool without assigning new jobs until every
@@ -230,8 +265,7 @@ class Worker:
         pool = self.pool
         while any(slot is not None for slot in pool.slots):
             for verb, payload in pool.step():
-                with payload_trace(payload):
-                    send_recv(self.conn, (verb, payload))
+                self._ship(verb, payload)
 
     def run(self):
         try:
@@ -246,6 +280,8 @@ class Worker:
         except _PEER_GONE:
             pass  # learner/gather went away: exit quietly
         finally:
+            if self.pipeline is not None:
+                self.pipeline.close()  # unmap; the learner owns unlink
             telemetry.flush()  # ship the span-log tail before exit
 
 
@@ -269,6 +305,10 @@ class Gather(QueueCommunicator):
     """
 
     CACHED_VERBS = ("model",)
+    # per-worker round trips forwarded to the learner verbatim,
+    # uncached and unbatched: the shm handshake's reply (ring names,
+    # client slot) is unique to the asking worker
+    FORWARD_VERBS = ("shm",)
     CACHE_CAPACITY = 4  # per verb; epochs advance, so old keys go cold
     FLUSH_AGE = 0.5  # seconds an upload may wait for batch-mates
     # surge-hold defaults (overridden by _init_surge; class-level so
@@ -448,6 +488,8 @@ class Gather(QueueCommunicator):
                 self._serve_job(conn)
             elif verb in self.reply_cache:
                 self._serve_cached(conn, verb, payload)
+            elif verb in self.FORWARD_VERBS:
+                self.send(conn, self._ask_learner((verb, payload)))
             else:
                 self._stage_upload(conn, verb, payload)
             self._flush_if_stale()

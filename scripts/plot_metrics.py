@@ -267,6 +267,43 @@ def plot(epochs, out_prefix):
                     bbox_inches="tight")
         print(f"wrote {out_prefix}_offpolicy.png")
 
+    # pipelined inference (handyrl_tpu.pipeline via the metrics jsonl):
+    # infer_batch_size_{mean,p95} shows how well the batching window
+    # coalesces requests across workers (pinned at one worker's rows =
+    # the window never spans processes), shm_ring_full_count is the
+    # transport's backpressure (climbing = rings undersized, episodes
+    # spilling to the control plane), and infer_queue_wait_sec (right
+    # axis) is what the window costs in latency
+    inf_cnt_keys = [k for k in ("infer_batch_size_mean",
+                                "infer_batch_size_p95",
+                                "infer_batches",
+                                "shm_ring_full_count",
+                                "infer_respawns")
+                    if any(k in e for e in epochs)]
+    inf_sec_keys = [k for k in ("infer_queue_wait_sec",)
+                    if any(k in e for e in epochs)]
+    if inf_cnt_keys or inf_sec_keys:
+        fig, ax = plt.subplots(figsize=(8, 5))
+        for k in inf_cnt_keys:
+            pts = series(xs, epochs, k)
+            if pts:
+                ax.plot(*zip(*pts), label=k, marker=".")
+        ax.set_xlabel("epoch")
+        ax.set_ylabel("rows (batch size) / count")
+        ax2 = ax.twinx()
+        for k in inf_sec_keys:
+            pts = series(xs, epochs, k)
+            if pts:
+                ax2.plot(*zip(*pts), label=k, linestyle="--")
+        ax2.set_ylabel("window wait, seconds")
+        lines, labels = ax.get_legend_handles_labels()
+        lines2, labels2 = ax2.get_legend_handles_labels()
+        ax.legend(lines + lines2, labels + labels2, fontsize=8)
+        ax.grid(alpha=0.3)
+        fig.savefig(out_prefix + "_inference.png", dpi=120,
+                    bbox_inches="tight")
+        print(f"wrote {out_prefix}_inference.png")
+
     # generation stats (mean +- std band)
     pts = [(x, e["generation_mean"], e.get("generation_std", 0.0))
            for x, e in zip(xs, epochs) if "generation_mean" in e]

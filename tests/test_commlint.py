@@ -163,6 +163,36 @@ def test_return_verb_summary_through_instance_attr():
     assert {"episode", "result"} <= set(an.sent_verbs)
 
 
+def test_tuple_head_at_wrapper_payload_makes_a_verb_param():
+    """The Worker._ship shape (PR 9's ship-or-spill helper between the
+    shm transport and the control plane): a function that forwards
+    ``(verb, payload)`` — verb a PARAMETER — into a send wrapper's
+    payload slot is itself a verb-head wrapper, and the verb-table /
+    return-verb flows resolve through it at its call sites."""
+    src = (
+        "def send_recv(conn, sdata):\n"
+        "    conn.send(sdata)\n"
+        "    return conn.recv(timeout=5)\n\n\n"
+        "class Worker:\n"
+        "    def __init__(self, gen, ev):\n"
+        "        self.roles = {'g': (gen, 'episode'),\n"
+        "                      'e': (ev, 'result')}\n\n"
+        "    def _ship(self, verb, payload):\n"
+        "        if self.ring is not None and self.ring.push(payload):\n"
+        "            return\n"
+        "        send_recv(self.conn, (verb, payload))\n\n"
+        "    def work(self, job):\n"
+        "        runner, reply_verb = self.roles[job['role']]\n"
+        "        self._ship(reply_verb, runner(job))\n")
+    from handyrl_tpu.analysis.astutil import ModuleInfo, Package
+
+    package = Package([ModuleInfo("m", "m", src)])
+    an = analyze_comm(package)
+    assert {"episode", "result"} <= set(an.sent_verbs)
+    # the wrapper's send_recv body makes its call sites round trips
+    assert all(s.expects_reply for s in an.sent_verbs["episode"])
+
+
 def test_trace_codec_send_is_transparent():
     """The telemetry envelope codec is a send head, not a new verb: a
     literal verb wrapped in ``wrap_trace(...)`` is still collected (and
@@ -357,6 +387,10 @@ def test_repo_protocol_graph_is_populated():
     an = analyze_comm(package)
     worker_plane = {"args", "model", "episode", "result", "beat"}
     battle_plane = {"update", "outcome", "action", "observe", "quit"}
+    # the pipelined dataflow's only control-plane verb: the shm
+    # handshake (pipeline.client sends it via send_recv, the gather
+    # forwards it verbatim, learner._on_shm answers the descriptor)
+    pipeline_plane = {"shm"}
     assert worker_plane <= set(an.sent_verbs), (
         f"worker-plane verbs not discovered as sent: "
         f"{worker_plane - set(an.sent_verbs)}")
@@ -365,7 +399,20 @@ def test_repo_protocol_graph_is_populated():
         f"battle-plane verbs not discovered as sent: "
         f"{battle_plane - set(an.sent_verbs)}")
     assert battle_plane <= set(an.handled_verbs)
-    # round-trip semantics: model fetches expect replies, quit is
-    # fire-and-forget by protocol (its handler breaks without a reply)
+    assert pipeline_plane <= set(an.sent_verbs), (
+        f"pipeline verbs not discovered as sent: "
+        f"{pipeline_plane - set(an.sent_verbs)}")
+    assert pipeline_plane <= set(an.handled_verbs)
+    # round-trip semantics: model fetches and the shm handshake expect
+    # replies, quit is fire-and-forget by protocol (its handler breaks
+    # without a reply)
     assert all(s.expects_reply for s in an.sent_verbs["model"])
+    assert all(s.expects_reply for s in an.sent_verbs["shm"])
     assert not any(s.expects_reply for s in an.sent_verbs["quit"])
+    # episode/result reach their sends through Worker._ship (the
+    # ship-or-spill helper between the shm transport and the control
+    # plane): the verb-table and return-verb-summary flows must
+    # survive that indirection (see
+    # test_tuple_head_at_wrapper_payload_makes_a_verb_param)
+    assert any(s.module.name.endswith("worker")
+               for s in an.sent_verbs["episode"])

@@ -7,6 +7,7 @@ import os
 import re
 
 from handyrl_tpu.config import TrainConfig, WorkerConfig
+from handyrl_tpu.pipeline.config import PipelineConfig
 from handyrl_tpu.resilience.chaos import ChaosConfig
 
 DOCS = os.path.join(os.path.dirname(__file__), "..", "docs",
@@ -30,6 +31,8 @@ def _config_keys():
         keys.add(field.name)
     for field in dataclasses.fields(ChaosConfig):
         keys.add(field.name)  # the documented chaos.* sub-keys
+    for field in dataclasses.fields(PipelineConfig):
+        keys.add(field.name)  # the documented pipeline.* sub-keys
     keys.update({"env", "opponent"})  # env_args.env + eval.opponent
     return keys
 

@@ -86,12 +86,12 @@ def test_net_carries_state_and_update_steps(tmp_path):
 def test_ring_budget_caps_at_grf_byte_cost():
     """At ~MB-scale episodes the byte budget must bite: a small
     device_replay_mb cap shrinks the ring instead of OOMing."""
-    from handyrl_tpu.staging import DeviceReplay, _decompress_episode
+    from handyrl_tpu.staging import DeviceReplay
 
     _, _, eps = _episodes(2, max_steps=64)
     replay = DeviceReplay(CFG, capacity=4096, max_bytes=64 << 20)
-    for ep in eps:
-        replay._append(_decompress_episode(ep))
+    replay.offer(eps)
+    replay.ingest()
     # (72*96*16 uint8 + narrow lane-padded channels) * t_max ~= 14 MB
     # per slot -> 64 MiB holds only a handful of slots
     assert replay.capacity <= 8

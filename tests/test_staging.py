@@ -65,12 +65,12 @@ def _host_batch(episodes, draws, cfg, players, monkeypatch):
 def _device_batch(episodes, draws, cfg):
     import jax.numpy as jnp
 
-    from handyrl_tpu.staging import DeviceReplay, _decompress_episode
+    from handyrl_tpu.staging import DeviceReplay
 
     replay = DeviceReplay(cfg, capacity=len(episodes) + 2,
                           max_bytes=1 << 30)
-    for ep in episodes:
-        replay._append(_decompress_episode(ep))
+    replay.offer(episodes)
+    replay.ingest(max_episodes=len(episodes))
     slots = jnp.asarray([d[0] for d in draws], jnp.int32)
     tstarts = jnp.asarray([d[1] for d in draws], jnp.int32)
     seats = jnp.asarray([d[2] for d in draws], jnp.int32)
@@ -152,15 +152,16 @@ def test_ring_eviction_and_growth():
     """FIFO eviction past capacity; T_max growth re-lays the ring."""
     import jax.numpy as jnp
 
-    from handyrl_tpu.staging import DeviceReplay, _decompress_episode
+    from handyrl_tpu.staging import DeviceReplay
 
     cfg = dict(CFG_BASE, turn_based_training=True)
     episodes, _ = _make_episodes("Geister", cfg, count=5)
     episodes.sort(key=lambda e: e["steps"])
     replay = DeviceReplay(cfg, capacity=3, max_bytes=1 << 30,
                           max_steps_hint=4)  # force growth
-    for ep in episodes:
-        replay._append(_decompress_episode(ep))
+    for ep in episodes:  # one-episode batches: every growth step runs
+        replay.offer([ep])
+        replay.ingest()
     assert replay.size == 3
     assert replay.episodes_seen == 5
     assert replay.t_max >= max(e["steps"] for e in episodes)
@@ -183,13 +184,13 @@ def test_device_draw_distribution_and_determinism():
     import jax
     import jax.numpy as jnp
 
-    from handyrl_tpu.staging import DeviceReplay, _decompress_episode
+    from handyrl_tpu.staging import DeviceReplay
 
     cfg = dict(CFG_BASE, turn_based_training=False)  # seat mode
     episodes, players = _make_episodes("TicTacToe", cfg, count=10)
     replay = DeviceReplay(cfg, capacity=16, max_bytes=1 << 30)
-    for ep in episodes:
-        replay._append(_decompress_episode(ep))
+    replay.offer(episodes)
+    replay.ingest(max_episodes=len(episodes))
 
     key = jax.random.PRNGKey(0)
     B = 4096
@@ -217,18 +218,19 @@ def test_device_draw_distribution_and_determinism():
 
 
 def test_batched_ingest_equals_single_appends():
-    """offer() + batched ingest() writes the same ring as one-by-one
-    appends (consecutive-slot runs upload as a single device write)."""
+    """The ring contents are invariant in the ingest run size: one-
+    episode runs (the smallest scatter the batched-only path can
+    issue) write the same ring as four-episode runs."""
     import jax
 
-    from handyrl_tpu.staging import DeviceReplay, _decompress_episode
+    from handyrl_tpu.staging import DeviceReplay
 
     cfg = dict(CFG_BASE, turn_based_training=True)
     episodes, _ = _make_episodes("TicTacToe", cfg, count=9)
 
     ref = DeviceReplay(cfg, capacity=16, max_bytes=1 << 30)
-    for ep in episodes:
-        ref._append(_decompress_episode(ep))
+    ref.offer(episodes)
+    ref.ingest(batch=1)
 
     batched = DeviceReplay(cfg, capacity=16, max_bytes=1 << 30)
     batched.offer(episodes)
@@ -245,21 +247,21 @@ def test_batched_ingest_equals_single_appends():
 def test_growth_respects_byte_budget():
     """When wider slots no longer fit the budget, growth shrinks the
     ring, keeping the newest episodes."""
-    from handyrl_tpu.staging import DeviceReplay, _decompress_episode
+    from handyrl_tpu.staging import DeviceReplay
 
     cfg = dict(CFG_BASE, turn_based_training=True)
     episodes, _ = _make_episodes("Geister", cfg, count=5)
     episodes.sort(key=lambda e: e["steps"])
-    short = _decompress_episode(episodes[0])
     replay = DeviceReplay(cfg, capacity=400, max_bytes=1 << 30,
                           max_steps_hint=episodes[0]["steps"])
-    replay._append(short)
+    replay.offer([episodes[0]])
+    replay.ingest()
     # shrink the budget so doubling T_max must cost ring capacity
     per_step = replay._per_step_bytes
     # ~300 slot-widths at the OLD t_max: after doubling, only ~150 fit
     replay.max_bytes = per_step * replay.t_max * 300
-    for ep in episodes[1:]:
-        replay._append(_decompress_episode(ep))
+    replay.offer(episodes[1:])
+    replay.ingest()
     assert replay.capacity < 400
     assert replay.size == min(5, replay.capacity)
     batch = replay.sample(4)
@@ -353,14 +355,14 @@ def test_ingest_batch_larger_than_tiny_ring_stays_coherent():
     nondeterministically.  Pin equality with the sequential path."""
     import jax
 
-    from handyrl_tpu.staging import DeviceReplay, _decompress_episode
+    from handyrl_tpu.staging import DeviceReplay
 
     cfg = dict(CFG_BASE, turn_based_training=True)
     episodes, _ = _make_episodes("TicTacToe", cfg, count=8)
 
     ref = DeviceReplay(cfg, capacity=3, max_bytes=1 << 30)
-    for ep in episodes:
-        ref._append(_decompress_episode(ep))
+    ref.offer(episodes)
+    ref.ingest(batch=1)  # one-episode runs: the minimal scatter
 
     batched = DeviceReplay(cfg, capacity=3, max_bytes=1 << 30)
     batched.offer(episodes)
