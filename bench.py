@@ -638,6 +638,244 @@ def pipeline_main(rounds=3, epochs=3):
     }))
 
 
+ANAKIN_TRAIN_ARGS = {
+    "turn_based_training": True, "observation": False,
+    "gamma": 0.8, "forward_steps": 8, "burn_in_steps": 0,
+    "compress_steps": 4, "entropy_regularization": 0.1,
+    "entropy_regularization_decay": 0.1,
+    "update_episodes": 60, "batch_size": 64,
+    "minimum_episodes": 40, "maximum_episodes": 400,
+    "num_batchers": 1, "eval_rate": 0.05,
+    "lambda": 0.7, "policy_target": "VTRACE",
+    "value_target": "VTRACE", "seed": 3,
+    "metrics_path": "metrics.jsonl",
+    "telemetry": False,  # measure the dataflow, not spans
+}
+
+
+def _anakin_engine(num_envs, seed=3):
+    """A standalone fused-rollout engine (ceiling measurements)."""
+    from handyrl_tpu.anakin import AnakinConfig, AnakinEngine
+    from handyrl_tpu.environment import make_env, make_jax_env
+    from handyrl_tpu.models import TPUModel
+    from handyrl_tpu.ops.losses import LossConfig
+    from handyrl_tpu.ops.update import make_optimizer
+
+    env = make_env({"env": "TicTacToe"})
+    env.reset()
+    model = TPUModel(env.net())
+    model.init_params(env.observation(env.players()[0]), seed=seed)
+    cfg = dict(ANAKIN_TRAIN_ARGS, eval={"opponent": ["random"]})
+    engine = AnakinEngine(
+        make_jax_env({"env": "TicTacToe"}), model,
+        LossConfig.from_config(cfg), make_optimizer(1e-3),
+        AnakinConfig.from_config({"mode": "on", "num_envs": num_envs}),
+        seed=seed)
+    return engine, model
+
+
+def anakin_train_child(epochs=3, num_envs=512, updates_per_epoch=8):
+    """Real-Learner training in Anakin mode; emits one JSON line of
+    steady-state fused throughput plus the acceptance-guard counters.
+
+    Steady state skips the first epoch (it pays the fused-step compile
+    and worker bring-up).  The child HARD-ASSERTS the fused step's
+    contract — exactly one compile across the run and zero resharding
+    copies, straight from the per-epoch guard metrics — so a shape or
+    layout regression fails the bench, not just dents the number.
+    After the run it also times the rollout alone (one extra jit): the
+    engine's GENERATION ceiling with no update attached, the
+    apples-to-apples twin of the host pool microbenchmark."""
+    import shutil
+    import tempfile
+
+    work = tempfile.mkdtemp(prefix="bench_anakin_")
+    cwd = os.getcwd()
+    os.chdir(work)
+    try:
+        args = {
+            "env_args": {"env": "TicTacToe"},
+            "train_args": {
+                **ANAKIN_TRAIN_ARGS, "epochs": epochs,
+                "updates_per_epoch": updates_per_epoch,
+                "worker": {"num_parallel": 1},
+                "max_update_compiles": 1, "max_resharding_copies": 1,
+                "anakin": {"mode": "on", "num_envs": num_envs},
+            },
+            "worker_args": {"num_parallel": 1, "server_address": ""},
+        }
+        from handyrl_tpu.learner import Learner
+
+        Learner(args).run()
+        with open("metrics.jsonl") as f:
+            recs = [json.loads(line) for line in f if line.strip()]
+    finally:
+        os.chdir(cwd)
+        shutil.rmtree(work, ignore_errors=True)
+
+    for rec in recs:
+        assert rec["retrace_count"] == 1, (
+            f"fused step compiled {rec['retrace_count']}x "
+            f"(epoch {rec['epoch']}): shape churn in the hot loop")
+        assert rec["resharding_copies"] == 0, (
+            f"{rec['resharding_copies']} resharding copies "
+            f"(epoch {rec['epoch']}): an input changed layout mid-run")
+    post = recs[1:] or recs
+    dt = recs[-1]["time_sec"] - recs[0]["time_sec"]
+    frames = sum(r["anakin_frames"] for r in post)
+    games = sum(r["anakin_games"] for r in post)
+    steps = recs[-1]["steps"] - recs[0]["steps"]
+    out = {
+        "anakin_env_frames_per_sec": round(frames / dt, 1) if dt else None,
+        "anakin_games_per_sec": round(games / dt, 1) if dt else None,
+        "anakin_steps_per_sec_fused": round(steps / dt, 2) if dt else None,
+        "fused_step_compiles": max(r["retrace_count"] for r in recs),
+        "resharding_copies": sum(r["resharding_copies"] for r in recs),
+    }
+
+    # generation ceiling: the rollout alone, no update attached
+    import jax
+    import jax.numpy as jnp
+
+    engine, model = _anakin_engine(num_envs=1024)
+    roll = jax.jit(engine._rollout)
+    params = jax.tree.map(jnp.array, model.params)
+    batch, carry, frames_dev = roll(params, (), engine.init_carry(0))
+    jax.block_until_ready(frames_dev)  # compile outside the window
+    total, iters = 0, 6
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        batch, carry, frames_dev = roll(params, (), carry)
+        total += int(frames_dev)
+    ceiling_dt = time.perf_counter() - t0
+    out["anakin_rollout_frames_per_sec"] = round(total / ceiling_dt, 1)
+    print(json.dumps(out))
+    sys.stdout.flush()
+    os._exit(0)  # skip non-daemonic gather joins (intake_child idiom)
+
+
+def anakin_host_child(epochs=3):
+    """The comparator: the SAME real-Learner training fed by the host
+    actor path (spawned workers, framed control plane, device replay).
+    Emits fresh env frames/s delivered into the learner over the same
+    steady-state window, plus the lockstep-pool microbenchmark (the
+    host generation ceiling with no transport or learner contention)."""
+    import shutil
+    import tempfile
+    import time as _time
+
+    work = tempfile.mkdtemp(prefix="bench_anakin_host_")
+    cwd = os.getcwd()
+    os.chdir(work)
+    try:
+        args = {
+            "env_args": {"env": "TicTacToe"},
+            "train_args": {
+                **ANAKIN_TRAIN_ARGS, "epochs": epochs,
+                "updates_per_epoch": 40,
+                "worker": {"num_parallel": 2},
+            },
+            "worker_args": {"num_parallel": 2, "server_address": ""},
+        }
+        from handyrl_tpu.learner import Learner
+
+        learner = Learner(args)
+        arrivals = []  # (learner-clock timestamp, env frames)
+        orig_feed = learner.feed_episodes
+
+        def feed(episodes):
+            arrivals.append((
+                _time.monotonic() - learner._run_t0,
+                sum(e["steps"] for e in episodes if e)))
+            orig_feed(episodes)
+
+        learner.feed_episodes = feed
+        learner.run()
+        with open("metrics.jsonl") as f:
+            recs = [json.loads(line) for line in f if line.strip()]
+    finally:
+        os.chdir(cwd)
+        shutil.rmtree(work, ignore_errors=True)
+
+    # the same steady-state window as the anakin child: first epoch
+    # record (post worker bring-up + compile) to the last
+    t_lo, t_hi = recs[0]["time_sec"], recs[-1]["time_sec"]
+    frames = sum(n for t, n in arrivals if t_lo < t <= t_hi)
+    dt = t_hi - t_lo
+    out = {
+        "host_env_frames_per_sec": round(frames / dt, 1) if dt else None,
+    }
+    cfg = dict(ANAKIN_TRAIN_ARGS, eval={"opponent": ["random"]})
+    pool_sps, _ = _pool_throughput(
+        "TicTacToe", cfg, k=16, target_episodes=400)
+    out["host_pool_frames_per_sec"] = round(pool_sps, 1)
+    print(json.dumps(out))
+    sys.stdout.flush()
+    os._exit(0)
+
+
+def anakin_main(rounds=3, epochs=3):
+    """Anakin variant (one JSON line, like main): fused on-device
+    rollout+update vs the host actor path, as two REAL-Learner
+    trainings on the same TicTacToe config — interleaved pairwise per
+    round and ratioed within rounds, the `--pipeline`/`--durability`
+    discipline (this host swings far more between trial blocks than
+    either path's margin).
+
+    Two ratios land in the JSON: the PATH ratio (fresh env frames/s
+    trained by the fused loop vs delivered into the learner by the
+    worker stack — the number the Anakin architecture exists to move,
+    and the acceptance gate's >= 10x), and the generation-CEILING
+    ratio (rollout-only jit vs the lockstep pool microbenchmark —
+    both sides stripped of update/transport, the component view)."""
+    anakin_fps, host_fps, ratios = [], [], []
+    roll_fps, pool_fps = [], []
+    extras = {}
+    for _ in range(rounds):
+        host = _run_child("--anakin-host-child", timeout=900,
+                          extra=[str(epochs)])
+        fused = _run_child("--anakin-child", timeout=900,
+                           extra=[str(epochs)])
+        if fused.get("anakin_env_frames_per_sec") \
+                and host.get("host_env_frames_per_sec"):
+            anakin_fps.append(fused["anakin_env_frames_per_sec"])
+            host_fps.append(host["host_env_frames_per_sec"])
+            ratios.append(fused["anakin_env_frames_per_sec"]
+                          / host["host_env_frames_per_sec"])
+            for k in ("anakin_games_per_sec",
+                      "anakin_steps_per_sec_fused",
+                      "fused_step_compiles", "resharding_copies"):
+                if fused.get(k) is not None:
+                    extras.setdefault(k, []).append(fused[k])
+        if fused.get("anakin_rollout_frames_per_sec"):
+            roll_fps.append(fused["anakin_rollout_frames_per_sec"])
+        if host.get("host_pool_frames_per_sec"):
+            pool_fps.append(host["host_pool_frames_per_sec"])
+    if not ratios:
+        print(json.dumps({"metric": "anakin_env_frames_speedup",
+                          "error": "no complete rounds"}))
+        return
+    out = {
+        "metric": "anakin_env_frames_speedup",
+        "value": round(_median(ratios), 1),
+        "unit": ("fused on-device env frames/s vs host-actor-path env "
+                 "frames/s (TicTacToe, two real Learner runs per "
+                 f"round, median of {len(ratios)} interleaved rounds; "
+                 "gate >= 10)"),
+        "anakin_env_frames_per_sec": _median(anakin_fps),
+        "host_env_frames_per_sec": _median(host_fps),
+        **{k: _median(v) for k, v in extras.items()},
+        "rounds": {"anakin": anakin_fps, "host": host_fps,
+                   "ratios": [round(r, 1) for r in ratios]},
+    }
+    if roll_fps and pool_fps:
+        out["anakin_rollout_frames_per_sec"] = _median(roll_fps)
+        out["host_pool_frames_per_sec"] = _median(pool_fps)
+        out["generation_ceiling_ratio"] = round(
+            _median(roll_fps) / _median(pool_fps), 1)
+    print(json.dumps(out))
+
+
 def measure_width_sweep(seed, widths=(32, 64, 128, 256),
                         batch_size=BATCH):
     """Steps/s + MFU vs GeeseNet width at the flagship batch: settles
@@ -1272,5 +1510,14 @@ if __name__ == "__main__":
     elif "--pipeline" in sys.argv:
         tail = [a for a in sys.argv[2:] if a.isdigit()]
         pipeline_main(rounds=int(tail[0]) if tail else 3)
+    elif "--anakin-child" in sys.argv:
+        tail = [a for a in sys.argv[2:] if a.isdigit()]
+        anakin_train_child(epochs=int(tail[0]) if tail else 3)
+    elif "--anakin-host-child" in sys.argv:
+        tail = [a for a in sys.argv[2:] if a.isdigit()]
+        anakin_host_child(epochs=int(tail[0]) if tail else 3)
+    elif "--anakin" in sys.argv:
+        tail = [a for a in sys.argv[2:] if a.isdigit()]
+        anakin_main(rounds=int(tail[0]) if tail else 3)
     else:
         main()

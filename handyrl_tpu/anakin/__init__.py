@@ -1,0 +1,31 @@
+"""handyrl_tpu.anakin — fused on-device rollout + update for JAX envs.
+
+Podracer's Anakin architecture (arXiv:2104.06272): for envs with a
+pure-JAX twin in ``environment.JAX_ENV_REGISTRY``, env stepping,
+inference, batch assembly, and the optimizer update run as ONE jitted,
+``vmap``'d program on the device — thousands of lockstep self-play
+games per chip, zero control-plane traffic in the hot path.  Non-JAX
+envs keep the IMPALA worker path; the worker fleet still runs
+evaluation either way.
+
+Public surface: :class:`AnakinConfig` (the validated ``anakin.*``
+config keys), :class:`AnakinEngine` (the fused-step builder the
+Trainer drives).
+
+``AnakinEngine`` resolves lazily (PEP 562): config validation
+(``TrainConfig.__post_init__``) imports this package, and — like
+``pipeline.config`` — it must stay importable without pulling jax
+into processes that have not pinned a backend yet.  Only the learner,
+which already runs jax, ever touches the engine.
+"""
+
+from .config import AnakinConfig  # noqa: F401
+
+
+def __getattr__(name):
+    if name == "AnakinEngine":
+        from .rollout import AnakinEngine
+
+        return AnakinEngine
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

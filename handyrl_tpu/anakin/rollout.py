@@ -1,0 +1,339 @@
+"""The Anakin engine: fused on-device rollout + batch + update.
+
+Podracer's Anakin architecture (arXiv:2104.06272) runs env stepping,
+inference, and the learner update as ONE jitted program on the device
+mesh — no actor processes, no control-plane traffic, no host work in
+the hot loop.  This module is that program for the pure-JAX envs in
+``environment.JAX_ENV_REGISTRY``:
+
+  * ``vmap`` advances ``num_envs`` self-play games in lockstep (the
+    env axis is the fused step's batch dimension);
+  * ``lax.scan`` unrolls one episode-aligned segment per step: every
+    game resets at segment start and must be able to terminate within
+    ``unroll_length`` env steps (>= the env's MAX_STEPS), so each env
+    row becomes exactly one complete-episode batch row — the same
+    semantics ``make_batch`` produces for the turn-based host path
+    (full window, outcome bootstrap on the padded tail);
+  * opponent seats draw from a batched OPPONENT-POOL axis: the env
+    axis factors into ``opponent_pool + 1`` equal groups — group 0
+    plays pure self-play (both seats the live policy), group k plays
+    the learner seat against frozen snapshot k — so scenario diversity
+    is one extra ``vmap`` dimension, not a fleet of processes.  The
+    learner seat alternates per game and per segment, and opponent
+    moves are recorded with the OPPONENT's behavior probabilities, so
+    the importance-sampling correction stays exact (the host league
+    path's contract);
+  * the segment's columnar records assemble into a training batch
+    in-jit and flow straight into :func:`ops.update.make_update_core`
+    — rollout, batch assembly, loss, grad, and Adam are one XLA
+    program with params/optimizer/carry donated across steps.  The
+    host contributes NOTHING per step (the carry — PRNG key + segment
+    counter — lives on device and rides the jit).
+
+PRNG discipline (jaxlint's prng-reuse rule polices this): the carry
+key splits once per segment into (next-carry, init, scan) keys, the
+scan key fans out one key per step, and each step key fans out one
+action key and one env key PER GAME.  No key is consumed twice.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..batch import ILLEGAL
+from ..ops.update import make_apply_fn, make_update_core
+from .config import AnakinConfig
+
+
+class AnakinEngine:
+    """Owns the rollout geometry and builds the fused step.
+
+    ``pool`` (the stacked frozen-snapshot pytree) is an ARGUMENT of the
+    fused step, not part of the donated carry: it is read-only inside a
+    step and refreshed only at epoch boundaries (``refresh_pool``
+    shifts the newest snapshot in, oldest out)."""
+
+    def __init__(self, jax_env, model, loss_cfg, optimizer,
+                 cfg: AnakinConfig, compute_dtype="float32", seed=0,
+                 mesh=None, params=None, fsdp=False):
+        if getattr(model, "is_recurrent", False):
+            raise ValueError(
+                "anakin mode supports feed-forward nets only (the "
+                "fused scan carries no hidden state yet)")
+        if not loss_cfg.turn_based_training or loss_cfg.observation:
+            raise ValueError(
+                "anakin mode requires turn_based_training: true and "
+                "observation: false (the fused batch layout is the "
+                "turn-gathered one)")
+        if loss_cfg.burn_in_steps:
+            raise ValueError(
+                "anakin mode requires burn_in_steps: 0 (segments are "
+                "whole episodes; there is no replayed warmup window)")
+        self.env = jax_env
+        self.model = model
+        self.loss_cfg = loss_cfg
+        self.optimizer = optimizer
+        self.compute_dtype = compute_dtype
+        self.seed = int(seed)
+        self.num_envs = cfg.num_envs
+        self.unroll = cfg.unroll_length or int(jax_env.MAX_STEPS)
+        if self.unroll < int(jax_env.MAX_STEPS):
+            raise ValueError(
+                f"anakin.unroll_length {self.unroll} < the env's "
+                f"MAX_STEPS {int(jax_env.MAX_STEPS)}: segments are "
+                "episode-aligned, so every game must be able to finish "
+                "inside one segment")
+        self.K = cfg.opponent_pool          # frozen snapshots
+        self.group = self.num_envs // (self.K + 1)
+        self.players = int(jax_env.NUM_PLAYERS)
+        self.num_actions = int(jax_env.NUM_ACTIONS)
+        self._apply = make_apply_fn(model, compute_dtype)
+        self._mesh = mesh
+        self._params_like = params if params is not None else model.params
+        self._fsdp = fsdp
+        self._rep = self._out = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            self._rep = NamedSharding(mesh, P())
+            self._out = NamedSharding(mesh, P("dp"))
+        self._refresh = None
+
+    # -- host-side state builders (once per run / per epoch) ----------
+
+    def init_carry(self, start_step=0):
+        """Device carry for the fused step: the segment PRNG key and
+        the segment counter.  Folding the resume step into the key
+        keeps restarted runs on a fresh data stream while staying
+        config-seed-deterministic."""
+        carry = {
+            "key": jax.random.fold_in(
+                jax.random.PRNGKey(self.seed), int(start_step)),
+            "seg": jnp.int32(int(start_step)),
+        }
+        if self._rep is not None:
+            carry = jax.device_put(carry, self._rep)
+        return carry
+
+    def init_pool(self, params):
+        """Stacked frozen-opponent params — ``opponent_pool`` copies of
+        the current params (every snapshot starts as "now"; epoch
+        boundaries shift real history in).  Empty pytree when the pool
+        is off, so the fused step keeps ONE signature either way."""
+        if self.K == 0:
+            return ()
+        stacked = jax.tree.map(
+            lambda a: jnp.stack([jnp.asarray(a)] * self.K), params)
+        if self._rep is not None:
+            stacked = jax.device_put(stacked, self._rep)
+        return stacked
+
+    def refresh_pool(self, pool, params):
+        """Epoch boundary: shift the newest snapshot into slot 0, drop
+        the oldest.  One small jitted shift (compiled once, outside the
+        fused step's retrace budget), donating the old pool."""
+        if self.K == 0:
+            return pool
+        if self._refresh is None:
+            def shift(pool, params):
+                return jax.tree.map(
+                    lambda stack, p: jnp.concatenate(
+                        [p[None].astype(stack.dtype), stack[:-1]]),
+                    pool, params)
+
+            self._refresh = jax.jit(
+                shift, donate_argnums=0,
+                **({} if self._rep is None
+                   else {"out_shardings": self._rep}))
+        return self._refresh(pool, params)
+
+    # -- the fused program --------------------------------------------
+
+    def _rollout(self, params, pool, carry):
+        """One traced segment: reset -> scan unroll steps -> batch.
+
+        Returns ``(batch, new_carry, frames)`` where ``batch`` is
+        bit-compatible with ``make_batch``'s turn-based layout (each
+        env row = one complete episode, padded tail carrying the
+        outcome bootstrap) and ``frames`` counts committed env
+        transitions."""
+        env = self.env
+        N, T, P, A = (self.num_envs, self.unroll, self.players,
+                      self.num_actions)
+        next_key, k_init, k_scan = jax.random.split(carry["key"], 3)
+        seg = carry["seg"]
+        # the learner's seat alternates per game AND per segment, so
+        # both seats see both roles whatever the group layout
+        learner_seat = (jnp.arange(N, dtype=jnp.int32) + seg) % 2
+        states = jax.vmap(env.init)(jax.random.split(k_init, N))
+
+        def scan_step(states, step_key):
+            active = ~jax.vmap(env.terminal)(states)
+            obs = jax.vmap(env.observe)(states)              # (N, ...)
+            legal = jax.vmap(env.legal_mask)(states)         # (N, A)
+            seat = jax.vmap(env.turn)(states)                # (N,)
+            out = self._apply(params, obs, None)
+            policy, value = out["policy"], out.get("value")
+            if self.K:
+                # grouped opponent forward: ONE vmap over the pool
+                # axis covers every frozen snapshot's games (group 0's
+                # opponent is the live policy itself — self-play)
+                pool_obs = jax.tree.map(
+                    lambda a: a[self.group:].reshape(
+                        (self.K, self.group) + a.shape[1:]), obs)
+                pout = jax.vmap(self._apply, in_axes=(0, 0, None))(
+                    pool, pool_obs, None)
+                opp_policy = jnp.concatenate(
+                    [policy[:self.group],
+                     pout["policy"].reshape(-1, A)])
+                is_learner = seat == learner_seat
+                policy = jnp.where(
+                    is_learner[:, None], policy, opp_policy)
+                if value is not None:
+                    opp_value = jnp.concatenate(
+                        [value[:self.group],
+                         pout["value"].reshape(
+                             (-1,) + value.shape[1:])])
+                    value = jnp.where(
+                        is_learner[:, None], value, opp_value)
+            # masked behavior policy, exactly agent.masked_logits:
+            # illegal entries REPLACED by -1e32, then a temperature-1
+            # softmax draw with the drawn probability recorded
+            masked = jnp.where(legal, policy, jnp.float32(-ILLEGAL))
+            k_act, k_env = jax.random.split(step_key)
+            act_keys = jax.random.split(k_act, N)
+            action = jax.vmap(jax.random.categorical)(act_keys, masked)
+            probs = jax.nn.softmax(masked, axis=-1)
+            prob = jnp.take_along_axis(
+                probs, action[:, None], axis=1)[:, 0]
+            env_keys = jax.random.split(k_env, N)
+            states, _, _, _, _ = jax.vmap(env.step)(
+                states, action, env_keys)
+            value_rec = (jnp.zeros(N, jnp.float32) if value is None
+                         else value[:, 0])
+            rec = {
+                # inactive rows carry make_batch's padding values:
+                # zero obs/action/value, prob 1.0, all-ILLEGAL mask
+                "obs": jax.tree.map(
+                    lambda a: jnp.where(
+                        active.reshape((N,) + (1,) * (a.ndim - 1)),
+                        a, 0.0), obs),
+                "prob": jnp.where(active, prob, 1.0),
+                "act": jnp.where(active, action, 0).astype(jnp.int32),
+                "amask": jnp.where(active[:, None] & legal,
+                                   jnp.float32(0), jnp.float32(ILLEGAL)),
+                "value": jnp.where(active, value_rec, 0.0),
+                "seat": seat,
+                "active": active,
+            }
+            return states, rec
+
+        final_states, recs = jax.lax.scan(
+            scan_step, states, jax.random.split(k_scan, T))
+        # scan stacks time leading: (T, N, ...) -> (N, T, ...)
+        recs = jax.tree.map(lambda a: jnp.swapaxes(a, 0, 1), recs)
+
+        active = recs["active"]                              # (N, T)
+        ep_len = active.astype(jnp.int32).sum(axis=1)        # (N,)
+        outcome = jax.vmap(env.outcome)(final_states)        # (N, P)
+        seat_oh = jax.nn.one_hot(recs["seat"], P,
+                                 dtype=jnp.float32)          # (N, T, P)
+        act_mask = active.astype(jnp.float32)                # (N, T)
+        turn_mask = seat_oh * act_mask[..., None]            # (N, T, P)
+        # acting player's value on their seat row; the padded tail
+        # bootstraps every seat with the final outcome (the host
+        # path's np.tile(outcome) padding)
+        v_rows = jnp.where(active[..., None],
+                           seat_oh * recs["value"][..., None],
+                           outcome[:, None, :])              # (N, T, P)
+        t_idx = jnp.arange(T, dtype=jnp.float32)[None, :]
+        progress = jnp.where(
+            active, t_idx / ep_len.astype(jnp.float32)[:, None], 1.0)
+        zeros_p = jnp.zeros((N, T, P, 1), jnp.float32)
+        batch = {
+            "observation": jax.tree.map(
+                lambda a: a[:, :, None], recs["obs"]),   # (N,T,1,...)
+            "selected_prob": recs["prob"][..., None, None],
+            "action": recs["act"][..., None, None],
+            "action_mask": recs["amask"][:, :, None, :],
+            "value": v_rows[..., None],
+            "reward": zeros_p,
+            "return": zeros_p,
+            "outcome": outcome[:, None, :, None],
+            "episode_mask": act_mask[..., None, None],
+            "turn_mask": turn_mask[..., None],
+            "observation_mask": turn_mask[..., None],
+            "progress": progress[..., None],
+        }
+        if self._out is not None:
+            batch = jax.tree.map(
+                lambda x: jax.lax.with_sharding_constraint(
+                    x, self._out), batch)
+        new_carry = {"key": next_key, "seg": seg + 1}
+        return batch, new_carry, ep_len.sum()
+
+    def make_fused_step(self):
+        """Build the jitted fused step.
+
+        Signatures (static per run, like the replay step):
+          * standard: ``step(params, opt_state, carry, pool) ->
+            (params, opt_state, metrics, carry)``
+          * impact:   ``step(params, opt_state, carry, pool,
+            target_params) -> (..., carry, target_params)``
+
+        ``params``/``opt_state``/``carry`` (and the impact target) are
+        donated; ``pool`` is read-only and survives across steps.
+        ``metrics`` carries the loss metrics plus ``anakin_frames`` /
+        ``anakin_games`` (committed transitions / completed games this
+        segment) as device scalars — fetched once per epoch with the
+        rest."""
+        core = make_update_core(self.model, self.loss_cfg,
+                                self.optimizer, self.compute_dtype)
+        impact = self.loss_cfg.update_algorithm == "impact"
+        games = jnp.int32(self.num_envs)
+
+        if impact:
+            def step(params, opt_state, carry, pool, target_params):
+                batch, carry, frames = self._rollout(
+                    params, pool, carry)
+                params, opt_state, metrics, target_params = core(
+                    params, opt_state, batch, target_params)
+                metrics = {**metrics, "anakin_frames": frames,
+                           "anakin_games": games}
+                return params, opt_state, metrics, carry, target_params
+        else:
+            def step(params, opt_state, carry, pool):
+                batch, carry, frames = self._rollout(
+                    params, pool, carry)
+                params, opt_state, metrics = core(
+                    params, opt_state, batch)
+                metrics = {**metrics, "anakin_frames": frames,
+                           "anakin_games": games}
+                return params, opt_state, metrics, carry
+
+        if self._mesh is None:
+            if impact:
+                return jax.jit(step, donate_argnums=(0, 1, 2, 4))
+            return jax.jit(step, donate_argnums=(0, 1, 2))
+
+        from ..parallel.mesh import param_sharding, replicated
+        from ..parallel.update import opt_state_sharding
+
+        p_shard = param_sharding(self._mesh, self._params_like,
+                                 fsdp=self._fsdp)
+        rep = replicated(self._mesh)
+        o_shard = opt_state_sharding(
+            self.optimizer, self._params_like, p_shard, rep)
+        if impact:
+            return jax.jit(
+                step,
+                in_shardings=(p_shard, o_shard, rep, rep, p_shard),
+                out_shardings=(p_shard, o_shard, rep, rep, p_shard),
+                donate_argnums=(0, 1, 2, 4),
+            )
+        return jax.jit(
+            step,
+            in_shardings=(p_shard, o_shard, rep, rep),
+            out_shardings=(p_shard, o_shard, rep, rep),
+            donate_argnums=(0, 1, 2),
+        )

@@ -231,6 +231,17 @@ class TrainConfig:
     # max_batch, ring_slots, slot_bytes, traj_slots, traj_slot_mb,
     # fallback, fallback_after, compress.  Empty = off (legacy path)
     pipeline: Dict[str, Any] = field(default_factory=dict)
+    # -- Anakin mode (handyrl_tpu.anakin; Podracer arXiv:2104.06272) --
+    # fused on-device rollout+update for envs with a pure-JAX twin
+    # (environment.JAX_ENV_REGISTRY): `mode: on|auto` runs env
+    # stepping, inference, batch assembly, and the optimizer update as
+    # ONE jitted, vmap'd program — generation leaves the worker fleet
+    # (which then only evaluates).  Keys (validated through
+    # AnakinConfig.from_config): mode, num_envs, unroll_length,
+    # opponent_pool.  Empty = off (the IMPALA worker path).  Requires
+    # updates_per_epoch > 0: the epoch cadence is the trainer's step
+    # count, since nothing ticks episode intake
+    anakin: Dict[str, Any] = field(default_factory=dict)
     # -- off-policy robustness (IMPACT, arXiv:1912.00167) --
     # "standard" (default): importance ratios against the live learner
     # policy, score-function policy loss — the reference behavior.
@@ -341,6 +352,17 @@ class TrainConfig:
         from .pipeline.config import PipelineConfig
 
         PipelineConfig.from_config(self.pipeline)
+        # anakin keys validate through the dataclass the fused rollout
+        # engine runs with; the epoch-cadence requirement is checked
+        # here because it crosses fields
+        from .anakin.config import AnakinConfig
+
+        if (AnakinConfig.from_config(self.anakin).enabled
+                and self.updates_per_epoch <= 0):
+            raise ValueError(
+                "anakin mode needs updates_per_epoch > 0 — the fused "
+                "loop makes its own data, so the epoch cadence is the "
+                "trainer's step count, not episode intake")
         if self.device_replay not in ("auto", "on", "off"):
             raise ValueError(
                 f"unknown device_replay {self.device_replay!r}")

@@ -27,10 +27,37 @@ ENV_REGISTRY = {
     "GRFProxy": "handyrl_tpu.envs.grf_proxy",
 }
 
+# pure-JAX twins of registered envs: functional (state, action, key)
+# modules the Anakin engine (handyrl_tpu.anakin) can vmap/scan inside
+# one jitted rollout+update program.  The Python env stays the spec —
+# a twin must bit-match its transition/reward/legal semantics (the
+# exhaustive parity test in tests/test_anakin.py enforces it for
+# TicTacToe).  Envs absent here keep the IMPALA worker path.
+JAX_ENV_REGISTRY = {
+    "TicTacToe": "handyrl_tpu.envs.tictactoe_jax",
+}
+
 
 def _resolve(env_args):
     name = env_args["env"]
     return importlib.import_module(ENV_REGISTRY.get(name, name))
+
+
+def jax_env_available(env_args) -> bool:
+    """Whether the configured env has a registered pure-JAX twin."""
+    return env_args.get("env") in JAX_ENV_REGISTRY
+
+
+def make_jax_env(env_args):
+    """Import the configured env's pure-JAX module (the functional
+    ``init/step/observe/...`` surface the Anakin engine drives)."""
+    name = env_args["env"]
+    if name not in JAX_ENV_REGISTRY:
+        raise ValueError(
+            f"env {name!r} has no pure-JAX twin (JAX_ENV_REGISTRY); "
+            "Anakin mode requires one — non-JAX envs use the IMPALA "
+            "worker path")
+    return importlib.import_module(JAX_ENV_REGISTRY[name])
 
 
 def prepare_env(env_args):

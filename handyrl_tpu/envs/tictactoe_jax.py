@@ -1,0 +1,161 @@
+"""Pure-JAX Tic-Tac-Toe: the functional twin of :mod:`.tictactoe`.
+
+The Python ``Environment`` is the SPEC; this module is its port onto
+jax arrays so the Anakin engine (:mod:`handyrl_tpu.anakin`) can
+``vmap`` thousands of concurrent games and ``lax.scan`` whole rollout
+segments inside one jitted program.  Transition, reward, terminal,
+legal-action, observation, and outcome semantics bit-match the Python
+env over every reachable state — tests/test_anakin.py enumerates the
+full reachable state space and asserts exactly that, so any divergence
+is a bug here, not a new convention.
+
+API shape (everything is a pure function over a :class:`State` pytree,
+safe under ``vmap``/``scan``/``jit``):
+
+    state = init(key)                      # fresh game (deterministic;
+                                           # the key is API for
+                                           # stochastic envs)
+    state, obs, reward, done, legal = step(state, action, key)
+
+plus the read-only views the rollout engine composes with: ``turn``
+(acting seat index), ``observe`` (the acting player's planes),
+``legal_mask``, ``terminal``, ``outcome``.
+
+Two deliberate hardenings beyond the Python env (which is only ever
+driven with legal actions by a Python loop): stepping a terminal state
+is a no-op, and an illegal action is a no-op — a ``vmap``'d fleet has
+no way to skip finished games, so finished/garbage rows must be inert
+rather than undefined.  On the legal-action space the transition is
+bit-identical to ``Environment.play``.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .tictactoe import FIRST, WIN_LINES
+
+NUM_PLAYERS = 2
+NUM_ACTIONS = 9
+MAX_STEPS = 9               # a game always terminates within 9 moves
+OBS_SHAPE = (3, 3, 3)       # channel-last planes, like the Python env
+
+
+class State(NamedTuple):
+    """One game's complete state (board determines everything else:
+    move count = filled cells, winner = the unique completed line)."""
+
+    cells: jnp.ndarray      # (9,) int8: 0 empty, +1 first mover, -1 second
+    count: jnp.ndarray      # ()  int32: moves played
+    winner: jnp.ndarray     # ()  int8:  0 none, +1 FIRST, -1 SECOND
+
+
+def init(key):
+    """Fresh game.  TicTacToe resets deterministically; ``key`` is part
+    of the functional-env API so stochastic envs slot in unchanged."""
+    del key
+    return State(
+        cells=jnp.zeros(NUM_ACTIONS, jnp.int8),
+        count=jnp.int32(0),
+        winner=jnp.int8(0),
+    )
+
+
+def side_to_move(state):
+    """+1/-1 mark of the mover (Environment.side_to_move)."""
+    return jnp.where(state.count % 2 == 0, FIRST, -FIRST).astype(jnp.int8)
+
+
+def turn(state):
+    """Acting seat index: player 0 always moves first
+    (Environment.turn == players()[len(history) % 2])."""
+    return (state.count % 2).astype(jnp.int32)
+
+
+def terminal(state):
+    """Environment.terminal: a winner, or a full board."""
+    return (state.winner != 0) | (state.count >= MAX_STEPS)
+
+
+def legal_mask(state):
+    """(9,) bool, True on empty cells — Environment.legal_actions
+    (which, like this, reports empty cells regardless of terminality;
+    the rollout engine gates on ``terminal`` separately)."""
+    return state.cells == 0
+
+
+def observe(state):
+    """The acting player's observation planes (HWC float32):
+    [is-turn-view (all ones), my marks, opponent marks] — exactly
+    ``Environment.observation(turn_player)``, the only view the
+    turn-based rollout ever requests."""
+    stm = side_to_move(state)
+    board = state.cells.reshape(3, 3)
+    return jnp.stack(
+        [
+            jnp.ones((3, 3), jnp.float32),
+            (board == stm).astype(jnp.float32),
+            (board == -stm).astype(jnp.float32),
+        ],
+        axis=-1,
+    )
+
+
+def outcome(state):
+    """(2,) float32 per-player scores (Environment.outcome): player 0's
+    score equals the winner mark (+1 first-mover win, -1 loss, 0 draw),
+    player 1's its negation."""
+    w = state.winner.astype(jnp.float32)
+    return jnp.stack([w, -w])
+
+
+def step(state, action, key):
+    """Apply the mover's mark at ``action``.
+
+    Returns ``(state, obs, reward, done, legal)`` where ``obs``/
+    ``legal`` describe the POST-move state (the next mover's view),
+    ``reward`` is the per-player outcome delivered on the terminating
+    transition (zeros before it — the Python env has no intermediate
+    rewards; its ``outcome()`` at the terminal state is this same
+    vector, asserted by the parity test), and ``done`` mirrors
+    ``terminal``.  Terminal states and occupied target cells are
+    no-ops (see module docstring)."""
+    del key  # deterministic transition; API slot for stochastic envs
+    stm = side_to_move(state)
+    valid = ~terminal(state) & (state.cells[action] == 0)
+    played = state.cells.at[action].set(stm)
+    cells = jnp.where(valid, played, state.cells)
+    # Environment.play's win check: any line summing to 3 * mover
+    marks = cells[jnp.asarray(WIN_LINES)].sum(axis=1)
+    won = jnp.any(marks == 3 * stm)
+    winner = jnp.where(valid & won, stm, state.winner)
+    new = State(
+        cells=cells,
+        count=state.count + valid.astype(jnp.int32),
+        winner=winner,
+    )
+    done = terminal(new)
+    reward = jnp.where(done & valid, outcome(new), jnp.zeros(NUM_PLAYERS))
+    return new, observe(new), reward, done, legal_mask(new)
+
+
+def from_board(cells):
+    """Build a State from a host board vector (tests / tooling): the
+    board alone determines count and winner for every legally reachable
+    position (play stops the moment a line completes, so a reachable
+    board has at most one winning mark)."""
+    cells = np.asarray(cells, np.int8)
+    marks = cells[WIN_LINES].sum(axis=1)
+    if np.any(marks == 3 * FIRST):
+        winner = FIRST
+    elif np.any(marks == -3 * FIRST):
+        winner = -FIRST
+    else:
+        winner = 0
+    return State(
+        cells=jnp.asarray(cells),
+        count=jnp.int32(int(np.count_nonzero(cells))),
+        winner=jnp.int8(winner),
+    )

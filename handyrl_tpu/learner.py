@@ -475,7 +475,22 @@ class Trainer:
         else:
             self.optimizer = None
 
-        self.device_replay = self._maybe_device_replay()
+        # Anakin mode (handyrl_tpu.anakin): for envs with a pure-JAX
+        # twin, rollout + batch assembly + update run as ONE jitted
+        # program per step — generation leaves the worker fleet
+        # entirely (workers only evaluate), so the replay machinery
+        # below is skipped
+        self.anakin = None
+        self._anakin_step = None
+        self.anakin_carry = None
+        self.anakin_pool = None
+        self.anakin_frames_total = 0.0
+        self.anakin_games_total = 0.0
+        if self.optimizer is not None:
+            self._maybe_build_anakin()
+
+        self.device_replay = (None if self.anakin is not None
+                              else self._maybe_device_replay())
         self._replay_step = None
         if self.device_replay is not None and not self.multihost:
             from .staging import make_replay_update_step
@@ -495,9 +510,74 @@ class Trainer:
         # the host batcher farm exists only when the device-resident
         # path is off: skipping it frees host cores for actors
         self.batcher = None
-        if self.optimizer is not None and self.device_replay is None:
+        if (self.optimizer is not None and self.device_replay is None
+                and self.anakin is None):
             self.batcher = Batcher(self.args, self.episodes,
                                    batch_size=self.local_batch_size)
+
+    def _maybe_build_anakin(self):
+        """Arm the fused on-device rollout+update (Anakin, ROADMAP
+        item 2) when configured AND the env has a pure-JAX twin.
+
+        ``anakin.mode: on`` makes an unusable setup an error;
+        ``auto`` falls back loudly to the IMPALA worker path (remote
+        workers, multi-host replicas, and envs without a registered
+        JAX twin all keep the worker path).  The fused step rides the
+        same RetraceGuard/ShardingContractGuard as the other update
+        paths: exactly one compile per run, zero resharding copies."""
+        from .anakin import AnakinConfig, AnakinEngine
+        from .environment import jax_env_available, make_jax_env
+
+        acfg = AnakinConfig.from_config(self.args.get("anakin") or {})
+        if not acfg.enabled:
+            return
+        env_args = self.args.get("env") or {}
+        if self.multihost:
+            msg = ("anakin mode is single-process (multi-host learners "
+                   "keep the IMPALA path)")
+        elif not jax_env_available(env_args):
+            msg = (f"env {env_args.get('env')!r} has no pure-JAX twin "
+                   "in JAX_ENV_REGISTRY")
+        else:
+            msg = None
+        if msg:
+            if acfg.mode == "on":
+                raise ValueError("anakin.mode: on — " + msg)
+            print(f"WARNING: {msg}; falling back to the IMPALA "
+                  "worker path")
+            return
+        if self.train_mesh is not None:
+            dp = int(self.train_mesh.shape.get("dp", 1)) or 1
+            if acfg.num_envs % dp != 0:
+                raise ValueError(
+                    f"anakin.num_envs {acfg.num_envs} must be "
+                    f"divisible by the mesh dp axis ({dp}): the env "
+                    "axis is the fused step's batch dimension")
+        try:
+            self.anakin = AnakinEngine(
+                make_jax_env(env_args), self.model, self.loss_cfg,
+                self.optimizer, acfg, compute_dtype=self.compute_dtype,
+                seed=self.args.get("seed", 0), mesh=self.train_mesh,
+                params=self.params, fsdp=self.train_fsdp)
+        except ValueError as exc:
+            # layout constraints (recurrent net, observation mode,
+            # burn-in, short unroll) make anakin UNAVAILABLE, which is
+            # exactly what auto falls back on; `on` means require it
+            if acfg.mode == "on":
+                raise
+            print(f"WARNING: anakin unavailable ({exc}); falling "
+                  "back to the IMPALA worker path")
+            return
+        self._anakin_step = self.retrace_guard.wrap(
+            self._wrap_sharding(self.anakin.make_fused_step()))
+        # the carry folds the resumed step count into its PRNG stream,
+        # so a restart continues on fresh data deterministically
+        self.anakin_carry = self.anakin.init_carry(self.steps)
+        self.anakin_pool = self.anakin.init_pool(self.params)
+        print(f"anakin mode: {self.anakin.num_envs} on-device games x "
+              f"{self.anakin.unroll}-step segments"
+              + (f", opponent pool {self.anakin.K}"
+                 if self.anakin.K else " (pure self-play)"))
 
     def _wrap_sharding(self, step):
         if self.shard_guard is None:
@@ -937,6 +1017,48 @@ class Trainer:
             batch_cnt += 1
         return batch_cnt, metric_acc
 
+    def _epoch_loop_anakin(self):
+        """Anakin epoch: self-play rollout, batch assembly, and the
+        optimizer update are ONE jitted program per step (donated
+        params/optimizer/carry; the opponent pool rides read-only).
+        The host dispatches the call and nothing else — no intake, no
+        ring, no prefetch; ``updates_per_epoch`` (required > 0) is the
+        epoch budget, after which the loop idles until the learner
+        asks for the snapshot."""
+        cap = self.updates_cap
+        batch_cnt, metric_acc = 0, []
+        while batch_cnt == 0 or not self.update_flag:
+            if self.shutdown_flag:
+                return None
+            self._maybe_emergency_save()
+            if cap and batch_cnt >= cap:
+                time.sleep(0.01)
+                continue
+            t0 = telemetry.span_begin()
+            with self.timers.section("update"):
+                if self.target_params is not None:
+                    (self.params, self.opt_state, metrics,
+                     self.anakin_carry,
+                     self.target_params) = self._anakin_step(
+                        self.params, self.opt_state, self.anakin_carry,
+                        self.anakin_pool, self.target_params)
+                else:
+                    (self.params, self.opt_state, metrics,
+                     self.anakin_carry) = self._anakin_step(
+                        self.params, self.opt_state, self.anakin_carry,
+                        self.anakin_pool)
+            # static attrs only: the committed frame count is a device
+            # scalar, and fetching it here would be a per-step host
+            # sync (it rides the metrics fetch at the epoch boundary)
+            telemetry.span_end("anakin.rollout", t0,
+                               games=self.anakin.num_envs,
+                               unroll=self.anakin.unroll)
+            self.trace.tick()
+            self.steps += 1
+            metric_acc.append(metrics)
+            batch_cnt += 1
+        return batch_cnt, metric_acc
+
     def _global_from_local_shards(self, local_batch):
         """Assemble global batch arrays from this process's local
         per-device shards (device replay under multi-host).  Pure
@@ -1009,6 +1131,8 @@ class Trainer:
 
         if self.multihost:
             result = self._epoch_loop_multihost()
+        elif self.anakin is not None:
+            result = self._epoch_loop_anakin()
         elif self.device_replay is not None:
             result = self._epoch_loop_device()
         else:
@@ -1087,6 +1211,16 @@ class Trainer:
                 self.device_replay.episodes_seen
             self.last_metrics["replay_dropped"] = \
                 self.device_replay.dropped
+        if self.anakin is not None:
+            # fused-rollout production this epoch (committed env
+            # transitions / completed games); the learner divides by
+            # epoch wall time into anakin_{frames,games}_per_sec
+            frames = sum(float(m["anakin_frames"]) for m in metric_acc)
+            games = sum(float(m["anakin_games"]) for m in metric_acc)
+            self.anakin_frames_total += frames
+            self.anakin_games_total += games
+            self.last_metrics["anakin_frames"] = int(frames)
+            self.last_metrics["anakin_games"] = int(games)
         # off-policy robustness telemetry (docs/observability.md):
         # is_clip_frac is the mean fraction of acting steps whose
         # importance ratio hit the clip this epoch (standard: rho >
@@ -1111,6 +1245,12 @@ class Trainer:
             else:
                 age = self.steps  # frozen target: age = run length
             self.last_metrics["target_net_age"] = age
+        if self.anakin is not None and self.anakin.K > 0:
+            # epoch boundary: the newest snapshot joins the vectorized
+            # opponent axis (oldest falls off) — scenario diversity as
+            # one device-side shift instead of a league scheduler
+            self.anakin_pool = self.anakin.refresh_pool(
+                self.anakin_pool, self.params)
         self.epoch += 1
         if self.primary:  # process 0 owns the (shared) checkpoint dir
             try:
@@ -1167,7 +1307,11 @@ class Trainer:
         try:
             # warmup wait lives inside try so the finally block owns
             # trace.close() on every exit path, including warmup-abort
-            if self.device_replay is not None:
+            if self.anakin is not None:
+                # generation is on-device: there is no intake backlog
+                # to warm — the first fused step makes its own data
+                print("started training")
+            elif self.device_replay is not None:
                 # warm the ring itself: episodes stream into HBM as
                 # they arrive, so training starts with a full ring.
                 # A ring smaller than minimum_episodes (explicit config
@@ -1307,6 +1451,7 @@ class Learner:
     # drive single subsystems via Learner.__new__) keep working: a real
     # __init__ overrides all of these
     worker = None
+    trainer = None
     max_policy_lag = 0
     episodes_rejected_stale = 0
     _rejected_epoch = 0
@@ -1410,6 +1555,13 @@ class Learner:
         self._last_sweep = 0.0
         self.trainer = Trainer(self.args, self.model)
         self.trainer.manifest = self.manifest if self.primary else None
+        # anakin epoch cadence: generation is on-device, so nothing
+        # ticks episodes_received — epochs ride the trainer's own step
+        # count instead (updates_per_epoch steps per epoch, config-
+        # validated > 0 whenever anakin is configured)
+        self._anakin_epoch_at = (
+            self.trainer.steps
+            + int(self.args.get("updates_per_epoch", 0) or 0))
         self.replay = ReplayBuffer(
             self.trainer.episodes, self.args["maximum_episodes"])
         self.metrics_path = self.args.get("metrics_path") or ""
@@ -1510,6 +1662,16 @@ class Learner:
         }
         if self.wal is not None:
             snap["wal"] = self.wal.stats()
+        trainer = getattr(self, "trainer", None)
+        if trainer is not None and \
+                getattr(trainer, "anakin", None) is not None:
+            snap["anakin"] = {
+                "num_envs": trainer.anakin.num_envs,
+                "unroll_length": trainer.anakin.unroll,
+                "opponent_pool": trainer.anakin.K,
+                "frames_total": int(trainer.anakin_frames_total),
+                "games_total": int(trainer.anakin_games_total),
+            }
         if self.infer_service is not None:
             snap["pipeline"] = {
                 **self.infer_service.stats(),
@@ -1899,6 +2061,17 @@ class Learner:
         self.update_model(model, steps)
         record["steps"] = steps
         record.update(getattr(self.trainer, "last_metrics", {}))
+        if "anakin_frames" in record:
+            # fused-rollout throughput (docs/observability.md):
+            # committed env transitions / completed self-play games
+            # per second of epoch wall time — the number the Anakin
+            # path exists to move by orders of magnitude
+            wall = record.get("epoch_wall_sec") or 0.0
+            if wall > 0:
+                record["anakin_frames_per_sec"] = round(
+                    record["anakin_frames"] / wall, 1)
+                record["anakin_games_per_sec"] = round(
+                    record["anakin_games"] / wall, 1)
         record.update(self._fleet_record())
         if self.infer_service is not None:
             # pipelined-inference telemetry (docs/observability.md):
@@ -1989,7 +2162,16 @@ class Learner:
                 or stats.get("slots_dead", 0) < slots
                 or self.shutdown_flag):
             return
-        if not self.multihost:
+        if getattr(self.trainer, "anakin", None) is not None:
+            # anakin: the fleet only evaluates — generation is on
+            # device, so training continues; just lose the win-rate
+            # stream LOUDLY instead of killing a healthy run
+            if now - getattr(self, "_fleet_dead_warned", 0.0) > 30.0:
+                self._fleet_dead_warned = now
+                print("WARNING: the entire eval worker fleet is dead; "
+                      "anakin training continues WITHOUT win-rate "
+                      "evaluation")
+        elif not self.multihost:
             print("ERROR: the entire local gather fleet is dead "
                   "(circuit breaker tripped on every slot); shutting "
                   "down — raise max_respawns or fix the crash in the "
@@ -2143,6 +2325,30 @@ class Learner:
                 if self.trainer.shutdown_flag:
                     self.shutdown_flag = True
                     self.worker.begin_drain()
+            elif (self.trainer.anakin is not None
+                    and not self.shutdown_flag
+                    and self.trainer.failure is not None):
+                # a dead fused loop can never advance the step clock,
+                # and nothing else ticks anakin epochs — an idle spin
+                # here would serve a frozen model forever, so exit
+                # LOUDLY instead (the IMPALA path instead degrades to
+                # serving the last snapshot, because intake keeps its
+                # epoch cadence alive)
+                print("ERROR: anakin trainer thread failed "
+                      f"({self.trainer.failure!r}); shutting down — "
+                      "nothing advances epochs without the fused loop")
+                self.shutdown_flag = True
+                self.worker.begin_drain()
+            elif (self.trainer.anakin is not None
+                    and not self.shutdown_flag
+                    and self.trainer.steps >= self._anakin_epoch_at):
+                # anakin: the fused loop makes its own data, so the
+                # epoch clock is the trainer's step count, not intake
+                self._anakin_epoch_at += self.args["updates_per_epoch"]
+                self.update()
+                if 0 <= self.args["epochs"] <= self.model_epoch:
+                    self.shutdown_flag = True
+                    self.worker.begin_drain()
             # episodes drained from worker pools after shutdown still
             # land in the buffer but must not start extra epochs
             elif (self.episodes_received >= next_epoch_at
@@ -2183,7 +2389,12 @@ class Learner:
         pool routes them down its sequential path."""
         players = self.env.players()
         league_seat = past = None
-        wants_eval = self.jobs_evaluated < self.eval_rate * self.jobs_generated
+        # anakin mode: generation runs on-device inside the fused
+        # step, so the worker fleet is evaluation-only — every job is
+        # an eval match and the win-rate stream keeps its cadence
+        wants_eval = (
+            getattr(self.trainer, "anakin", None) is not None
+            or self.jobs_evaluated < self.eval_rate * self.jobs_generated)
         if wants_eval:
             seat = self.jobs_evaluated % len(players)
             trained = [players[seat]]

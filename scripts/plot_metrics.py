@@ -304,6 +304,40 @@ def plot(epochs, out_prefix):
                     bbox_inches="tight")
         print(f"wrote {out_prefix}_inference.png")
 
+    # anakin throughput (handyrl_tpu.anakin via the metrics jsonl):
+    # anakin_frames_per_sec / anakin_games_per_sec are the fused
+    # on-device rollout's production rate — the raw-speed number the
+    # architecture exists to move; a dip means the fused step slowed
+    # (retrace/reshard regressions show on the guards plot) or the
+    # epoch boundary stretched.  steps ride the right axis so the
+    # update cadence is visible next to the frame rate
+    ank_rate_keys = [k for k in ("anakin_frames_per_sec",
+                                 "anakin_games_per_sec")
+                     if any(k in e for e in epochs)]
+    ank_cnt_keys = [k for k in ("anakin_frames",)
+                    if any(k in e for e in epochs)]
+    if ank_rate_keys or ank_cnt_keys:
+        fig, ax = plt.subplots(figsize=(8, 5))
+        for k in ank_rate_keys:
+            pts = series(xs, epochs, k)
+            if pts:
+                ax.plot(*zip(*pts), label=k, marker=".")
+        ax.set_xlabel("epoch")
+        ax.set_ylabel("frames / games per second")
+        ax2 = ax.twinx()
+        for k in ank_cnt_keys:
+            pts = series(xs, epochs, k)
+            if pts:
+                ax2.plot(*zip(*pts), label=k, linestyle="--")
+        ax2.set_ylabel("frames per epoch")
+        lines, labels = ax.get_legend_handles_labels()
+        lines2, labels2 = ax2.get_legend_handles_labels()
+        ax.legend(lines + lines2, labels + labels2, fontsize=8)
+        ax.grid(alpha=0.3)
+        fig.savefig(out_prefix + "_anakin.png", dpi=120,
+                    bbox_inches="tight")
+        print(f"wrote {out_prefix}_anakin.png")
+
     # generation stats (mean +- std band)
     pts = [(x, e["generation_mean"], e.get("generation_std", 0.0))
            for x, e in zip(xs, epochs) if "generation_mean" in e]

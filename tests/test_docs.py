@@ -6,6 +6,7 @@ import dataclasses
 import os
 import re
 
+from handyrl_tpu.anakin.config import AnakinConfig
 from handyrl_tpu.config import TrainConfig, WorkerConfig
 from handyrl_tpu.pipeline.config import PipelineConfig
 from handyrl_tpu.resilience.chaos import ChaosConfig
@@ -33,6 +34,8 @@ def _config_keys():
         keys.add(field.name)  # the documented chaos.* sub-keys
     for field in dataclasses.fields(PipelineConfig):
         keys.add(field.name)  # the documented pipeline.* sub-keys
+    for field in dataclasses.fields(AnakinConfig):
+        keys.add(field.name)  # the documented anakin.* sub-keys
     keys.update({"env", "opponent"})  # env_args.env + eval.opponent
     return keys
 
