@@ -521,14 +521,22 @@ def durability_main(steps=12, eps_per_step=2):
 
 def pipeline_train_child(mode, epochs=3):
     """One short REAL-STACK local training (TicTacToe, spawned workers,
-    device replay) with the pipelined dataflow on or off; emits one
-    JSON line of e2e numbers parsed from its metrics.jsonl.
+    device replay) with the pipelined dataflow on, off, or on-under-
+    CHAOS; emits one JSON line of e2e numbers parsed from its
+    metrics.jsonl.
 
     The update budget is capped per epoch so the learner cannot spin
     updates while starved: steps/s then measures how fast the actor
     feed lets the learner cycle epochs — the end-to-end number the
     pipeline exists to move — and `batch_wait` reports the per-epoch
-    feed starvation alongside it."""
+    feed starvation alongside it.
+
+    ``mode: chaos`` is the fault-injection round: pipeline ON with
+    the inference service chaos-killed at epoch 1 AND a surge
+    brownout (upload hold) mid-measurement — the emitted numbers add
+    `recovery_sec` (kill record -> first served-again record) and the
+    spill/torn counters, so CI archives how much a real fault costs
+    against the clean pipelined round of the same bench run."""
     import shutil
     import tempfile
 
@@ -551,10 +559,22 @@ def pipeline_train_child(mode, epochs=3):
                 "policy_target": "VTRACE", "value_target": "VTRACE",
                 "seed": 3, "metrics_path": "metrics.jsonl",
                 "telemetry": False,  # measure the dataflow, not spans
-                "pipeline": {"mode": mode},
+                "pipeline": {"mode": "on" if mode == "chaos"
+                             else mode},
             },
             "worker_args": {"num_parallel": 2, "server_address": ""},
         }
+        if mode == "chaos":
+            # service kill + brownout mid-measurement: the respawn
+            # backoff is pinned so recovery_sec measures the ladder
+            # (stale board -> local fallback -> respawn -> served
+            # again), not a knob
+            args["train_args"]["respawn_backoff"] = 0.5
+            args["train_args"]["chaos"] = {
+                "infer_kill_epoch": 1,
+                "surge_epoch": 1, "surge_hold_uploads": 2.0,
+                "seed": 3,
+            }
         from handyrl_tpu.learner import Learner
 
         learner = Learner(args)
@@ -579,7 +599,7 @@ def pipeline_train_child(mode, epochs=3):
         "epoch_wall_sec": round(
             sum(r["epoch_wall_sec"] for r in post) / len(post), 3),
     }
-    if mode == "on":
+    if mode in ("on", "chaos"):
         served = [r for r in recs if r.get("infer_batches", 0) > 0]
         out["infer_batch_size_mean"] = round(sum(
             r["infer_batch_size_mean"] for r in served)
@@ -589,6 +609,25 @@ def pipeline_train_child(mode, epochs=3):
             / len(served), 6) if served else None
         out["shm_ring_full_count"] = recs[-1].get("shm_ring_full_count")
         out["infer_respawns"] = recs[-1].get("infer_respawns")
+    if mode == "chaos":
+        # recovery time: the kill fires inside the update() that
+        # advances the model to `infer_kill_epoch` — i.e. at the
+        # boundary that WRITES the (kill_epoch - 1) record — so the
+        # gap from that record to the first record that both
+        # respawned AND dispatched served batches is the fault's
+        # visible footprint (epoch-granular, an upper bound)
+        kill_epoch = args["train_args"]["chaos"]["infer_kill_epoch"]
+        kill_t = next((r["time_sec"] for r in recs
+                       if r["epoch"] == kill_epoch - 1), None)
+        back_t = next((r["time_sec"] for r in recs
+                       if r.get("infer_respawns", 0) >= 1
+                       and r.get("infer_batches", 0) > 0), None)
+        out["recovery_sec"] = (round(back_t - kill_t, 3)
+                               if kill_t is not None
+                               and back_t is not None else None)
+        out["episodes_spilled"] = sum(
+            r.get("episodes_spilled", 0) for r in recs)
+        out["shm_torn_slots"] = recs[-1].get("shm_torn_slots")
     print(json.dumps(out))
     sys.stdout.flush()
     os._exit(0)  # skip non-daemonic gather joins (intake_child idiom)
@@ -599,14 +638,24 @@ def pipeline_main(rounds=3, epochs=3):
     learner stack with pipelined inference + shm trajectories vs the
     legacy per-worker path, INTERLEAVED pairwise per round and ratioed
     within rounds — the same discipline as `--durability` (this host
-    swings far more between trial blocks than either path's margin)."""
+    swings far more between trial blocks than either path's margin).
+
+    Each round also runs a CHAOS leg: pipeline on with the inference
+    service killed and a surge brownout mid-measurement.  The JSON
+    reports the recovery time and the chaos/clean steps/s degradation
+    ratio next to the clean speedup, so a regression in the
+    degradation ladder (slow respawn, stuck fallback, spill storms)
+    moves a number CI archives."""
     legacy, piped, ratios, waits_l, waits_p = [], [], [], [], []
+    chaos_sps, chaos_deg, recovery = [], [], []
     extras = {}
     for _ in range(rounds):
         off = _run_child("--pipeline-child", timeout=900,
                          extra=["off", str(epochs)])
         on = _run_child("--pipeline-child", timeout=900,
                         extra=["on", str(epochs)])
+        chaos = _run_child("--pipeline-child", timeout=900,
+                           extra=["chaos", str(epochs)])
         if off.get("steps_per_sec_e2e") and on.get("steps_per_sec_e2e"):
             legacy.append(off["steps_per_sec_e2e"])
             piped.append(on["steps_per_sec_e2e"])
@@ -618,22 +667,42 @@ def pipeline_main(rounds=3, epochs=3):
                       "shm_ring_full_count", "infer_respawns"):
                 if on.get(k) is not None:
                     extras.setdefault(k, []).append(on[k])
+            if chaos.get("steps_per_sec_e2e"):
+                chaos_sps.append(chaos["steps_per_sec_e2e"])
+                chaos_deg.append(chaos["steps_per_sec_e2e"]
+                                 / on["steps_per_sec_e2e"])
+            if chaos.get("recovery_sec") is not None:
+                recovery.append(chaos["recovery_sec"])
     if not ratios:
         print(json.dumps({"metric": "pipeline_e2e_speedup",
                           "error": "no complete rounds"}))
         return
+    chaos_out = {}
+    if chaos_sps:
+        chaos_out = {
+            "learner_steps_per_sec_e2e_chaos": round(
+                _median(chaos_sps), 2),
+            # chaos / clean-pipelined steps/s within the same round:
+            # what the kill + brownout cost end to end (1.0 = free)
+            "chaos_degradation": round(_median(chaos_deg), 3),
+        }
+    if recovery:
+        chaos_out["chaos_recovery_sec"] = round(_median(recovery), 3)
     print(json.dumps({
         "metric": "pipeline_e2e_speedup",
         "value": round(_median(ratios), 3),
         "unit": ("pipelined / legacy e2e learner steps/s ratio "
                  "(TicTacToe real stack, 2 workers, "
-                 f"median of {len(ratios)} interleaved rounds)"),
+                 f"median of {len(ratios)} interleaved rounds; "
+                 "chaos leg = service kill + surge brownout)"),
         "learner_steps_per_sec_e2e_pipelined": round(_median(piped), 2),
         "learner_steps_per_sec_e2e_legacy": round(_median(legacy), 2),
         "e2e_batch_wait_sec_pipelined": round(_median(waits_p), 4),
         "e2e_batch_wait_sec_legacy": round(_median(waits_l), 4),
         **{k: _median(v) for k, v in extras.items()},
+        **chaos_out,
         "rounds": {"pipelined": piped, "legacy": legacy,
+                   "chaos": chaos_sps,
                    "ratios": [round(r, 3) for r in ratios]},
     }))
 
@@ -650,6 +719,11 @@ ANAKIN_TRAIN_ARGS = {
     "value_target": "VTRACE", "seed": 3,
     "metrics_path": "metrics.jsonl",
     "telemetry": False,  # measure the dataflow, not spans
+    # pinned OFF now that the repo default is on: this bench defines
+    # the fused-loop vs HOST-ACTOR-IMPALA comparison (the recorded
+    # 69.5x baseline and the >= 10x CI gate) — letting the host leg
+    # silently become pipelined would change the ratio's meaning
+    "pipeline": {"mode": "off"},
 }
 
 

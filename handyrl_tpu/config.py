@@ -218,18 +218,19 @@ class TrainConfig:
     # read-only learner status endpoint (live JSON over HTTP for
     # dashboards); 0 = off
     status_port: int = 0
-    # chaos fault injection for resilience tests (keys: kill_prob,
-    # kill_after, max_kills, frame_drop_prob, frame_truncate_prob,
-    # frame_delay_prob, frame_delay, seed); empty = off
+    # chaos fault injection for resilience tests (kill/frame/surge/
+    # learner-kill/infer-kill/shm_* keys — see ChaosConfig and
+    # docs/parameters.md); empty = off
     chaos: Dict[str, Any] = field(default_factory=dict)
     # -- pipelined rollout dataflow (handyrl_tpu.pipeline) --
-    # Sebulba-style split: `mode: on` replaces per-worker CPU inference
-    # with the learner's batched inference service and ships finished
-    # trajectories over the zero-copy shared-memory transport (the
-    # framed control plane keeps control verbs only).  Keys (validated
-    # through PipelineConfig.from_config): mode, batch_window,
-    # max_batch, ring_slots, slot_bytes, traj_slots, traj_slot_mb,
-    # fallback, fallback_after, compress.  Empty = off (legacy path)
+    # Sebulba-style split: per-worker CPU inference is replaced by the
+    # learner's batched inference service and finished trajectories
+    # ride the zero-copy shared-memory transport (the framed control
+    # plane keeps control verbs only).  Keys (validated through
+    # PipelineConfig.from_config): mode, batch_window, max_batch,
+    # ring_slots, slot_bytes, traj_slots, traj_slot_mb, fallback,
+    # fallback_after, compress.  Empty = ON (the default since the shm
+    # plane earned its chaos pedigree); {mode: 'off'} = legacy path
     pipeline: Dict[str, Any] = field(default_factory=dict)
     # -- Anakin mode (handyrl_tpu.anakin; Podracer arXiv:2104.06272) --
     # fused on-device rollout+update for envs with a pure-JAX twin

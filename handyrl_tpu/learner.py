@@ -1467,6 +1467,17 @@ class Learner:
     _infer_disabled = False
     _infer_kill_epoch = 0
     _infer_killed = False
+    # shm-vs-spill episode accounting (pipelined dataflow): cumulative
+    # and per-epoch counts of episodes that rode the trajectory rings
+    # vs episodes stamped ``shm_spilled`` (surge-hold overflow / full
+    # rings) arriving on the control plane — together they reconcile
+    # against episodes_received, the zero-loss proof
+    episodes_shm = 0
+    episodes_spilled = 0
+    _shm_epoch = 0
+    _spilled_epoch = 0
+    _upload_backlog_epoch = 0   # deepest this epoch (metrics record)
+    _upload_backlog_peak = 0    # deepest this run (status endpoint)
 
     def __init__(self, args, net=None, remote=False):
         from .config import Config
@@ -1616,7 +1627,7 @@ class Learner:
                 int(self.args.get("max_respawns", 5)), 60.0)
             self.infer_service = InferenceService(
                 self.model, self._pipeline_cfg,
-                epoch=self.model_epoch)
+                epoch=self.model_epoch, chaos=chaos_cfg)
             self.infer_service.start()
         # stall watchdog: the server loop and the communicator's
         # reader/writer threads beat once per pass; a loop silent past
@@ -1676,6 +1687,13 @@ class Learner:
             snap["pipeline"] = {
                 **self.infer_service.stats(),
                 "respawns": self._infer_respawns,
+                "episodes_shm": self.episodes_shm,
+                "episodes_spilled": self.episodes_spilled,
+                # run peak, not the per-epoch accumulator: every key
+                # in this section is cumulative-monotone, so a
+                # dashboard never sees a live backlog "vanish" at an
+                # epoch boundary reset
+                "upload_backlog_peak": self._upload_backlog_peak,
             }
         return snap
 
@@ -1887,6 +1905,21 @@ class Learner:
 
     def feed_episodes(self, episodes):
         arrived = [e for e in episodes if e is not None]
+        for episode in arrived:
+            # shm-plane transport stamps, popped BEFORE the episode
+            # can reach the WAL or the replay buffer: `shm_spilled`
+            # marks a control-plane spill (full ring / surge-hold
+            # overflow) and `upload_backlog` carries the worker-side
+            # hold-backlog depth at ship time — both reduced into the
+            # per-epoch brownout metrics
+            if episode.pop("shm_spilled", False):
+                self.episodes_spilled += 1
+                self._spilled_epoch += 1
+            backlog = episode.pop("upload_backlog", 0)
+            if backlog > self._upload_backlog_epoch:
+                self._upload_backlog_epoch = int(backlog)
+            if backlog > self._upload_backlog_peak:
+                self._upload_backlog_peak = int(backlog)
         if self.max_policy_lag > 0:
             # admission control: past-budget episodes are counted and
             # dropped BEFORE any stats/buffer touch them.  Rejected
@@ -2076,9 +2109,22 @@ class Learner:
         if self.infer_service is not None:
             # pipelined-inference telemetry (docs/observability.md):
             # per-epoch batch-size distribution, mean batching-window
-            # wait, cumulative ring-full backpressure, and respawns
+            # wait, cumulative ring-full backpressure, torn-slot
+            # skips, and respawns
             record.update(self.infer_service.epoch_stats())
             record["infer_respawns"] = self._infer_respawns
+            # shm-vs-spill episode accounting for this epoch plus the
+            # deepest worker-side hold backlog observed at intake —
+            # the brownout visibility triple (docs/observability.md):
+            # shm + spilled episodes reconcile against arrivals, so
+            # a surge hold is visible as spills and backlog, never as
+            # silent episode loss
+            record["episodes_shm"] = self._shm_epoch
+            record["episodes_spilled"] = self._spilled_epoch
+            record["upload_backlog"] = self._upload_backlog_epoch
+            self._shm_epoch = 0
+            self._spilled_epoch = 0
+            self._upload_backlog_epoch = 0
         if self.stall_watchdog is not None:
             # control-plane wedges this epoch (server loop + reader/
             # writer threads silent past max_stall_seconds); steady
@@ -2216,6 +2262,8 @@ class Learner:
             return
         episodes = svc.drain_trajectories(max_episodes=512)
         if episodes:
+            self.episodes_shm += len(episodes)
+            self._shm_epoch += len(episodes)
             with telemetry.trace_span("intake.shm",
                                       episodes=len(episodes)):
                 self.feed_episodes(episodes)

@@ -25,6 +25,7 @@ the local path (documented in docs/large_scale_training.md).
 """
 
 import time
+from collections import deque
 
 from .shm import ShmBoard, ShmRing, dumps, loads_view, pack_request
 
@@ -50,10 +51,12 @@ def attach_pipeline(conn, env, args):
     """Run the shm handshake; returns a PipelineClient or None (legacy
     path).  Any failure here is a degraded start, never a crash — the
     worker trains fine without the pipeline."""
+    from ..resilience.chaos import ChaosConfig
     from .config import PipelineConfig
 
     try:
         cfg = PipelineConfig.from_config(args.get("pipeline") or {})
+        chaos = ChaosConfig.from_config(args.get("chaos") or {})
     except ValueError:
         return None
     if not cfg.enabled:
@@ -70,7 +73,7 @@ def attach_pipeline(conn, env, args):
     if not desc:
         return None  # refused: remote learner / pipeline off / draining
     try:
-        return PipelineClient(desc, cfg)
+        return PipelineClient(desc, cfg, chaos=chaos)
     except (FileNotFoundError, OSError, ValueError) as exc:
         print(f"pipeline attach failed ({exc!r}); "
               "falling back to local inference")
@@ -78,10 +81,25 @@ def attach_pipeline(conn, env, args):
 
 
 class PipelineClient:
-    """One worker's mapped endpoint of the shm transport."""
+    """One worker's mapped endpoint of the shm transport.
+
+    Beyond the request/reply round trip, the client owns the worker
+    side of the SURGE BROWNOUT contract (``chaos.surge_hold_uploads``
+    must brown out shm-shipped episodes the same way the gather holds
+    its control-plane uploads): when the job stream first carries a
+    model id at/past ``chaos.surge_epoch``, :meth:`ship_episode`
+    stages finished episodes in a bounded backlog instead of the
+    trajectory ring; overflow spills to the control plane (stamped
+    ``shm_spilled``, counted, never dropped) and the post-hold drain
+    is paced — a small block per shipped episode, the same discipline
+    as the gather's ``flush_uploads``."""
 
     def __init__(self, desc, cfg, clock=time.monotonic,
-                 sleep=time.sleep):
+                 sleep=time.sleep, chaos=None):
+        import random
+
+        from ..resilience.chaos import maybe_chaos_ring
+
         self.cfg = cfg
         self.clock = clock
         self.sleep = sleep
@@ -90,6 +108,25 @@ class PipelineClient:
         self.req = ShmRing.attach(**desc["req"])
         self.rsp = ShmRing.attach(**desc["rsp"])
         self.traj = ShmRing.attach(**desc["traj"])
+        if chaos is not None and chaos.shm_faults_enabled:
+            # worker-side shm fault injection: this endpoint produces
+            # on req/traj and consumes rsp, so wrapping all three arms
+            # exactly the faults this side's role can express
+            rng = random.Random((chaos.seed << 20) ^ 0x5AD0
+                                ^ int(self.client_id))
+            self.req = maybe_chaos_ring(self.req, chaos, rng=rng)
+            self.rsp = maybe_chaos_ring(self.rsp, chaos, rng=rng)
+            self.traj = maybe_chaos_ring(self.traj, chaos, rng=rng)
+        # surge brownout (see class docstring): armed from the chaos
+        # config, triggered by the job stream via note_jobs
+        self._surge_epoch = chaos.surge_epoch if chaos else 0
+        self._surge_hold = chaos.surge_hold_uploads if chaos else 0.0
+        self._surge_pending = (chaos is not None and chaos.surges_enabled
+                               and self._surge_hold > 0)
+        self._hold_until = 0.0
+        self.backlog = deque()
+        self.backlog_cap = int(cfg.traj_slots)
+        self.episodes_held = 0     # cumulative episodes staged by a hold
         self.seq = 0
         self.fallbacks = 0        # served calls answered locally
         self.episodes_shipped = 0
@@ -166,7 +203,16 @@ class PipelineClient:
         deadline = self.clock() + max(
             self.cfg.fallback_after, 4 * self.cfg.batch_window)
         while True:
-            reply = self.rsp.pop(loads=loads_view)
+            try:
+                reply = self.rsp.pop(loads=loads_view)
+            except Exception as exc:
+                # a corrupt reply frame (truncated payload under a
+                # complete stamp) costs that slot, never the client:
+                # skip it loudly and keep waiting out the deadline
+                self.rsp.skip_one()
+                print(f"pipeline client {self.client_id}: corrupt "
+                      f"reply slot skipped ({exc!r})")
+                continue
             if reply is not None:
                 seq, epoch, outputs = reply
                 if seq == self.seq:
@@ -203,6 +249,83 @@ class PipelineClient:
             return True
         self.episodes_spilled += 1
         return False
+
+    # -- surge brownout -----------------------------------------------
+    def note_jobs(self, jobs):
+        """Arm the surge hold when the job stream first carries a
+        model id at/past ``chaos.surge_epoch`` — the same trigger (and
+        the same contract) as the gather's control-plane hold."""
+        if not self._surge_pending:
+            return
+        for job in jobs:
+            ids = (job or {}).get("model_id") or {}
+            if any(v >= self._surge_epoch for v in ids.values()):
+                self._surge_pending = False
+                self._hold_until = self.clock() + self._surge_hold
+                print(f"pipeline client {self.client_id}: surge — "
+                      f"holding shm episode shipping for "
+                      f"{self._surge_hold:.1f}s")
+                return
+
+    def holding(self):
+        return self.clock() < self._hold_until
+
+    def _spill_overflow(self, episode):
+        """An episode the hold window cannot buffer: stamped and
+        counted for the control plane — spilled, never dropped."""
+        episode["shm_spilled"] = True
+        episode["upload_backlog"] = len(self.backlog)
+        self.episodes_spilled += 1
+        return episode
+
+    DRAIN_BLOCK = 2  # backlog items drained per shipped episode
+
+    def ship_episode(self, episode):
+        """Route one finished episode: the shm trajectory ring, the
+        surge-hold backlog, or the control plane.  Returns the list of
+        episodes the CALLER must ship over the control plane (each
+        stamped ``shm_spilled``) — empty when everything rode shared
+        memory or was staged by an active hold."""
+        if self.holding():
+            self.backlog.append(episode)
+            self.episodes_held += 1
+            spill = []
+            while len(self.backlog) > self.backlog_cap:
+                spill.append(self._spill_overflow(self.backlog.popleft()))
+            return spill
+        # paced drain (flush_uploads discipline): the current episode
+        # plus a small block of held backlog per call, FIFO — a
+        # post-brownout backlog drains over the next few episodes
+        # instead of slamming the ring (and the learner's intake) as
+        # one burst
+        self.backlog.append(episode)
+        spill = []
+        budget = min(len(self.backlog), 1 + self.DRAIN_BLOCK)
+        while self.backlog and budget > 0:
+            budget -= 1
+            ep = self.backlog.popleft()
+            if self.backlog:
+                # brownout visibility: episodes shipped while a backlog
+                # remains carry its depth (reduced per epoch into the
+                # `upload_backlog` metric at the learner)
+                ep["upload_backlog"] = len(self.backlog)
+            if not self.push_episode(ep):  # counted spilled inside
+                ep["shm_spilled"] = True
+                spill.append(ep)
+        return spill
+
+    def flush_backlog(self):
+        """Exit drain: everything still held ships NOW — over the ring
+        where it fits, else returned for the control plane.  Episodes
+        are never dropped at exit (the gather's drain=True twin)."""
+        self._hold_until = 0.0
+        spill = []
+        while self.backlog:
+            ep = self.backlog.popleft()
+            if not self.push_episode(ep):
+                ep["shm_spilled"] = True
+                spill.append(ep)
+        return spill
 
     def close(self):
         self.board.close()

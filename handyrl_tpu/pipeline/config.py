@@ -22,18 +22,24 @@ FALLBACKS = ("local", "none")
 class PipelineConfig:
     """Knobs for the pipelined rollout dataflow (``pipeline:`` section).
 
-    ``mode: on`` replaces per-worker CPU inference with the learner's
-    batched inference service and ships finished trajectories over the
+    ``mode: on`` (the DEFAULT since the shm plane earned its chaos
+    pedigree — torn-slot, brownout, and spill drills in tier-1)
+    replaces per-worker CPU inference with the learner's batched
+    inference service and ships finished trajectories over the
     zero-copy shared-memory transport; the framed pickle control plane
     keeps carrying control verbs (jobs, model fetches, heartbeats)
-    only.  Remote worker machines cannot map the learner's shared
-    memory — their handshake is refused and they keep the legacy
-    local-inference path automatically.
+    only.  The auto-fallbacks make the default safe everywhere:
+    remote worker machines cannot map the learner's shared memory —
+    their handshake is refused and they keep the legacy
+    local-inference path automatically — and recurrent nets are never
+    wrapped (their hidden state lives on the worker).  ``mode: off``
+    restores the legacy per-worker path wholesale.
     """
 
     # off | on — whether workers attempt the shm handshake and the
-    # learner runs the batched inference service
-    mode: str = "off"
+    # learner runs the batched inference service.  Default ON: the
+    # fast path is the mainline path (ROADMAP item 3)
+    mode: str = "on"
     # seconds the service waits for batch-mates after the first
     # pending request before dispatching a (possibly partial) batch:
     # the latency half of the batching-window-vs-latency trade

@@ -101,7 +101,11 @@ class InferenceService:
     GRAVE_GRACE = 10.0  # close only after in-flight snapshots expire
 
     def __init__(self, model, cfg, epoch=0, clock=time.monotonic,
-                 sleep=time.sleep):
+                 sleep=time.sleep, chaos=None):
+        import random
+
+        from ..resilience.chaos import maybe_chaos_board
+
         self.cfg = cfg
         self.clock = clock
         self.sleep = sleep
@@ -111,7 +115,19 @@ class InferenceService:
         self._model = model
         self._epoch = int(epoch)
         self._pending_model = None
-        self.board = ShmBoard.create()
+        # shm chaos (resilience.ChaosRing/ChaosBoard): this side
+        # produces replies and consumes requests/trajectories, and its
+        # heartbeat can be withheld/backdated — all seeded off the one
+        # chaos RNG discipline so drills replay exactly
+        self._chaos = chaos if (chaos is not None
+                                and (chaos.shm_faults_enabled
+                                     or chaos.shm_beat_faults_enabled)
+                                ) else None
+        self._chaos_rng = (
+            random.Random((chaos.seed << 20) ^ 0xB0A2)
+            if self._chaos is not None else None)
+        self.board = maybe_chaos_board(
+            ShmBoard.create(), self._chaos, rng=self._chaos_rng)
         self._thread = None
         self._stop = False
         self._kill = False           # chaos: die WITHOUT a parting beat
@@ -124,6 +140,7 @@ class InferenceService:
         self.requests = 0            # cumulative request frames served
         self.rows_served = 0         # cumulative obs rows answered
         self.reclaimed = 0           # torn slots skipped (dead writers)
+        self.corrupt = 0             # undecodable slots skipped
         self.reply_drops = 0         # replies refused by a full/small ring
         self.reaped = 0              # idle clients reclaimed
         self._grave = []             # (deadline, client) pending close
@@ -143,15 +160,24 @@ class InferenceService:
             for shape, dtype in leaf_specs)
         need = 16 + 2 * rows_max * max(1, row_bytes)
         slot = max(int(self.cfg.slot_bytes), need)
+        from ..resilience.chaos import maybe_chaos_ring
+
         with self._lock:
             cid = self._next_cid
             self._next_cid += 1
+
+            def ring(*a):
+                # service-side chaos endpoint: reply pushes can tear/
+                # truncate/refuse, request/trajectory pops can stall
+                return maybe_chaos_ring(
+                    ShmRing.create(*a), self._chaos, rng=self._chaos_rng)
+
             client = _Client(
                 cid,
-                req=ShmRing.create(self.cfg.ring_slots, slot),
-                rsp=ShmRing.create(self.cfg.ring_slots, slot),
-                traj=ShmRing.create(self.cfg.traj_slots,
-                                    int(self.cfg.traj_slot_mb) << 20),
+                req=ring(self.cfg.ring_slots, slot),
+                rsp=ring(self.cfg.ring_slots, slot),
+                traj=ring(self.cfg.traj_slots,
+                          int(self.cfg.traj_slot_mb) << 20),
                 leaf_specs=leaf_specs,
                 example=spec["example"],
                 rows_max=rows_max,
@@ -232,6 +258,19 @@ class InferenceService:
                       + c.traj.full_count)
         return total
 
+    def torn_slot_count(self):
+        """Cumulative torn/corrupt slots skipped across every ring of
+        every client — the consumer-side skip counters live in the shm
+        headers, so this covers the WORKERS' reply-ring skips too (no
+        control-plane reporting needed), plus this side's reclaims."""
+        total = 0
+        with self._lock:
+            clients = list(self._clients.values())
+        for c in clients:
+            total += (c.req.torn_count + c.rsp.torn_count
+                      + c.traj.torn_count)
+        return total
+
     def epoch_stats(self):
         """Per-epoch reduction for metrics.jsonl; resets the epoch
         accumulators.  Keys are the docs/observability.md contract."""
@@ -246,6 +285,12 @@ class InferenceService:
             "infer_batches": len(rows),
             "infer_requests": requests,
             "shm_ring_full_count": self.ring_full_count(),
+            # torn/corrupt slots skipped, cumulative, read from the
+            # shm headers (covers both endpoints' skips).  Steady
+            # state is flat at 0; a climbing line means producers are
+            # dying mid-write (or payloads are corrupting) faster
+            # than the fleet's churn explains
+            "shm_torn_slots": self.torn_slot_count(),
         }
         if rows:
             srt = sorted(rows)
@@ -269,7 +314,9 @@ class InferenceService:
             "requests": self.requests,
             "rows_served": self.rows_served,
             "shm_ring_full_count": self.ring_full_count(),
+            "shm_torn_slots": self.torn_slot_count(),
             "torn_reclaimed": self.reclaimed,
+            "corrupt_slots": self.corrupt,
             "reply_drops": self.reply_drops,
             "clients_reaped": self.reaped,
         }
@@ -285,21 +332,38 @@ class InferenceService:
             clients = list(self._clients.values())
         for c in clients:
             while len(episodes) < max_episodes:
-                ep = c.traj.pop(loads=loads_view)
+                try:
+                    ep = c.traj.pop(loads=loads_view)
+                except Exception as exc:
+                    self._skip_corrupt(c.traj, c.cid, "trajectory", exc)
+                    continue
                 if ep is None:
                     c.traj_stuck_since = self._maybe_reclaim(
-                        c.traj, c.traj_stuck_since, now)
+                        c.traj, c.traj_stuck_since, now,
+                        cid=c.cid, kind="trajectory")
                     break
                 c.traj_stuck_since = None
                 c.last_seen = now
                 episodes.append(ep)
         return episodes
 
-    def _maybe_reclaim(self, ring, stuck_since, now):
+    def _skip_corrupt(self, ring, cid, kind, exc):
+        """A slot whose seqlock stamp is complete but whose payload
+        would not decode (truncation, bit rot): skip it LOUDLY — the
+        slot is counted torn in the shm header and the ring flows
+        again.  Crashing here would take the learner's server loop
+        (and every client) down over one bad frame."""
+        if ring.skip_one():
+            self.corrupt += 1
+            print(f"WARNING: corrupt {kind} slot from client {cid} "
+                  f"skipped ({exc!r})")
+
+    def _maybe_reclaim(self, ring, stuck_since, now, cid=-1,
+                       kind="request"):
         """Mid-write slot watch: a slot odd-stamped for longer than
         TORN_GRACE means its writer died mid-frame (a live writer
-        finishes in microseconds) — skip it so the ring flows again.
-        Returns the updated stuck-since stamp."""
+        finishes in microseconds) — skip it LOUDLY so the ring flows
+        again.  Returns the updated stuck-since stamp."""
         if not ring.pending() or ring.readable():
             return None
         if stuck_since is None:
@@ -307,6 +371,10 @@ class InferenceService:
         if now - stuck_since >= self.TORN_GRACE:
             if ring.skip_torn():
                 self.reclaimed += 1
+                print(f"WARNING: torn {kind} slot from client {cid} "
+                      f"reclaimed (writer dead mid-RESERVE-THEN-FILL, "
+                      f"stalled {now - stuck_since:.0f}s); the ring "
+                      f"flows again")
             return None
         return stuck_since
 
@@ -346,11 +414,17 @@ class InferenceService:
             clients = list(self._clients.values())
         for c in clients:
             while True:
-                item = c.req.pop(
-                    loads=lambda v, c=c: unpack_request(v, c.leaf_specs))
+                try:
+                    item = c.req.pop(
+                        loads=lambda v, c=c: unpack_request(
+                            v, c.leaf_specs))
+                except Exception as exc:
+                    self._skip_corrupt(c.req, c.cid, "request", exc)
+                    continue
                 if item is None:
                     c.req_stuck_since = self._maybe_reclaim(
-                        c.req, c.req_stuck_since, now)
+                        c.req, c.req_stuck_since, now,
+                        cid=c.cid, kind="request")
                     break
                 c.req_stuck_since = None
                 c.last_seen = self.clock()

@@ -213,6 +213,22 @@ class ShmRing:
         self._set(_TAIL, tail + 1)
         return True
 
+    def skip_one(self) -> bool:
+        """Advance past the next slot UNCONDITIONALLY, counting it as
+        torn.  For a slot whose seqlock stamp is complete (even) but
+        whose payload the consumer could not decode — truncation, bit
+        rot, a corrupt pickle: ``pop`` leaves such a slot in place
+        (its ``loads`` raised before the tail advanced), and without
+        this escape the poisoned slot would wedge the ring forever."""
+        if self._buf is None:
+            return False
+        tail = self._get(_TAIL)
+        if tail >= self._get(_HEAD):
+            return False
+        self._set(_TORN, self._get(_TORN) + 1)
+        self._set(_TAIL, tail + 1)
+        return True
+
     # -- lifecycle ----------------------------------------------------
     def close(self):
         if self._shm is None:

@@ -10,7 +10,10 @@ Three pillars (docs/large_scale_training.md "Fault tolerance"):
     the ``fleet_size`` / ``respawns`` / ``heartbeat_misses`` metrics.
   * :mod:`.chaos` — fault injection for tests: kill children at
     configured rates/points, delay/drop/truncate control-plane frames,
-    and SIGKILL the learner itself (:class:`LearnerKillSwitch`).
+    SIGKILL the learner itself (:class:`LearnerKillSwitch`), and
+    fault the shm pipeline plane (:class:`ChaosRing` /
+    :class:`ChaosBoard`: torn slots, forced backpressure, truncated
+    payloads, stalled consumers, withheld heartbeats).
   * :mod:`.guardian` — the same supervision policy applied to the
     LEARNER process: :class:`LearnerGuard` relaunches a crashed
     learner with ``restart_epoch: auto`` behind a backoff schedule and
@@ -26,10 +29,14 @@ gathers, episode intake) survive the same churn without a restart.
 """
 
 from .chaos import (
+    ChaosBoard,
     ChaosConfig,
     ChaosConnection,
     ChaosMonkey,
+    ChaosRing,
     LearnerKillSwitch,
+    maybe_chaos_board,
+    maybe_chaos_ring,
 )
 from .guardian import LearnerGuard
 from .health import FleetRegistry
@@ -37,12 +44,16 @@ from .supervisor import BackoffPolicy, SlotState, Supervisor
 
 __all__ = [
     "BackoffPolicy",
+    "ChaosBoard",
     "ChaosConfig",
     "ChaosConnection",
     "ChaosMonkey",
+    "ChaosRing",
     "FleetRegistry",
     "LearnerGuard",
     "LearnerKillSwitch",
     "SlotState",
     "Supervisor",
+    "maybe_chaos_board",
+    "maybe_chaos_ring",
 ]

@@ -10,6 +10,13 @@ tests.  The chaos harness makes failure a configured input:
   * :class:`ChaosConnection` wraps a connection and drops, delays, or
     truncates whole frames, driving the receiver's ``FrameError`` /
     dead-peer paths in unit tests.
+  * :class:`ChaosRing` / :class:`ChaosBoard` wrap the shm pipeline
+    plane (:mod:`handyrl_tpu.pipeline.shm`): torn slots (a producer
+    dying mid-RESERVE-THEN-FILL), forced full-ring backpressure,
+    truncated payloads, stalled consumers, and withheld/backdated
+    service heartbeats — the fault set that proves the seqlock
+    transport's degradation ladder the way the knobs above prove the
+    framed control plane's.
 
 All randomness flows through one injectable RNG (``seed`` in the
 config), so chaos tests are seedable and non-flaky.
@@ -62,6 +69,27 @@ class ChaosConfig:
     # learner epoch reaches this — workers must fall back to local CPU
     # inference and the learner must respawn the service.  Fires once
     infer_kill_epoch: int = 0     # learner epoch of the kill; 0 = off
+    # -- shm-plane fault injection (the pipeline's seqlock rings and
+    # heartbeat board; ChaosRing/ChaosBoard wrap the endpoints when
+    # any of these are armed).  Probabilities are per opportunity:
+    # per push for the producer faults, per pop for the consumer
+    # stall, per beat for the board faults.  One uniform draw per
+    # opportunity picks at most one fault, so each group must sum
+    # to <= 1 (same discipline as the frame_* knobs)
+    shm_tear_prob: float = 0.0      # P(push reserves the slot, then
+    #                                 "dies" mid-RESERVE-THEN-FILL:
+    #                                 odd stamp + head bump, no payload)
+    shm_full_prob: float = 0.0      # P(push refused as if the ring
+    #                                 were full — forced backpressure,
+    #                                 counted in the shm header)
+    shm_truncate_prob: float = 0.0  # P(push lands a payload cut in
+    #                                 half under a full-length header —
+    #                                 the consumer must skip, not crash)
+    shm_stall_prob: float = 0.0     # P(pop pretends nothing is
+    #                                 readable — a stalled consumer)
+    shm_beat_drop_prob: float = 0.0   # P(a service heartbeat is withheld)
+    shm_beat_delay_prob: float = 0.0  # P(a beat backdated by shm_beat_delay)
+    shm_beat_delay: float = 0.5       # seconds each delayed beat backdates
     seed: int = 0                 # seeds the shared chaos RNG
 
     @classmethod
@@ -73,7 +101,10 @@ class ChaosConfig:
             raise ValueError(f"unknown chaos keys: {sorted(unknown)}")
         cfg = cls(**raw)
         for name in ("kill_prob", "frame_drop_prob",
-                     "frame_truncate_prob", "frame_delay_prob"):
+                     "frame_truncate_prob", "frame_delay_prob",
+                     "shm_tear_prob", "shm_full_prob",
+                     "shm_truncate_prob", "shm_stall_prob",
+                     "shm_beat_drop_prob", "shm_beat_delay_prob"):
             p = getattr(cfg, name)
             if not 0.0 <= p <= 1.0:
                 raise ValueError(f"chaos.{name} must be in [0, 1]")
@@ -81,17 +112,24 @@ class ChaosConfig:
                      "surge_hold_uploads", "max_kills", "surge_epoch",
                      "surge_kills", "learner_kill_epoch",
                      "learner_kill_after_episodes",
-                     "infer_kill_epoch"):
+                     "infer_kill_epoch", "shm_beat_delay"):
             if getattr(cfg, name) < 0:
                 raise ValueError(f"chaos.{name} must be >= 0")
-        total = (cfg.frame_drop_prob + cfg.frame_truncate_prob
-                 + cfg.frame_delay_prob)
-        if total > 1.0:
-            # one uniform draw picks at most one fault per frame, so
-            # the configured rates only hold when they sum to <= 1
-            raise ValueError(
-                f"chaos frame probabilities must sum to <= 1 "
-                f"(got {total:g})")
+        for group, names in (
+                ("frame", ("frame_drop_prob", "frame_truncate_prob",
+                           "frame_delay_prob")),
+                ("shm push", ("shm_tear_prob", "shm_full_prob",
+                              "shm_truncate_prob")),
+                ("shm beat", ("shm_beat_drop_prob",
+                              "shm_beat_delay_prob"))):
+            total = sum(getattr(cfg, n) for n in names)
+            if total > 1.0:
+                # one uniform draw picks at most one fault per
+                # opportunity, so the configured rates only hold when
+                # they sum to <= 1
+                raise ValueError(
+                    f"chaos {group} probabilities must sum to <= 1 "
+                    f"(got {total:g})")
         return cfg
 
     @property
@@ -115,6 +153,18 @@ class ChaosConfig:
     @property
     def infer_kill_enabled(self) -> bool:
         return self.infer_kill_epoch > 0
+
+    @property
+    def shm_faults_enabled(self) -> bool:
+        return (self.shm_tear_prob > 0.0
+                or self.shm_full_prob > 0.0
+                or self.shm_truncate_prob > 0.0
+                or self.shm_stall_prob > 0.0)
+
+    @property
+    def shm_beat_faults_enabled(self) -> bool:
+        return (self.shm_beat_drop_prob > 0.0
+                or self.shm_beat_delay_prob > 0.0)
 
 
 class ChaosMonkey:
@@ -244,6 +294,186 @@ class LearnerKillSwitch:
               "drill, resume should recover")
         self._kill()
         return True
+
+
+class ChaosRing:
+    """A :class:`~handyrl_tpu.pipeline.shm.ShmRing` wrapper injecting
+    shm-plane faults from the seeded chaos RNG.
+
+    Producer faults ride ``push`` (each side of a ring only exercises
+    its own role's methods, so wrapping both endpoints never doubles a
+    fault class):
+
+      * **tear** — replay a producer dying mid-RESERVE-THEN-FILL: the
+        odd seqlock stamp and the head bump publish the reservation,
+        then the "producer" is gone — no payload, no even stamp.  The
+        consumer sees exactly what a SIGKILLed writer leaves behind.
+        Returns True: a dead producer reports nothing, so the item is
+        lost the same way it would be with a real death.
+      * **full** — forced backpressure: the push is refused and counted
+        in the shm header exactly like a genuinely full ring, driving
+        the producer's spill/fallback path.
+      * **truncate** — only half the payload lands (bit rot / a
+        partial DMA), short length recorded so EVERY codec's decode
+        fails — pickled payloads raise in loads, raw request frames
+        raise in np.frombuffer: the consumer must skip the slot
+        loudly, never crash (and never read garbage silently).
+
+    The consumer fault rides ``pop``: **stall** pretends nothing is
+    readable, backing the ring up so the producer's own full-ring
+    handling engages organically.
+
+    Everything else delegates to the wrapped ring (cursors, counters,
+    descriptor, close), so a ChaosRing drops in anywhere a ShmRing is
+    used.
+    """
+
+    def __init__(self, inner, cfg: ChaosConfig,
+                 rng: Optional[random.Random] = None):
+        self.inner = inner
+        self.cfg = cfg
+        self.rng = rng if rng is not None else random.Random(cfg.seed)
+        self.torn_injected = 0
+        self.full_injected = 0
+        self.truncated_injected = 0
+        self.stalls_injected = 0
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def __len__(self):
+        return len(self.inner)
+
+    @staticmethod
+    def _parts_bytes(parts):
+        if isinstance(parts, (bytes, bytearray, memoryview)):
+            return bytes(parts)
+        return b"".join(bytes(p) for p in parts)
+
+    def _fits(self, length, shm):
+        ring = self.inner
+        head = ring._get(shm._HEAD)
+        return (length <= ring.slot_bytes
+                and head - ring._get(shm._TAIL) < ring.slots)
+
+    def _tear(self, payload, shm):
+        """The real push's reservation prefix, then nothing — the
+        producer 'died' before the payload (or the even stamp) could
+        land.  A consumer with evidence the writer is gone reclaims
+        the slot via ``skip_torn``."""
+        ring = self.inner
+        head = ring._get(shm._HEAD)
+        shm._Q.pack_into(ring._buf, ring._slot_off(head), 2 * head + 1)
+        ring._set(shm._HEAD, head + 1)
+        self.torn_injected += 1
+        return True
+
+    def _truncate(self, payload, shm):
+        """A complete-looking slot (even stamp) holding only the first
+        half of the payload.  The recorded length is the CUT length —
+        deliberately, so every consumer detects it: a truncated pickle
+        stream raises in loads, and the raw request codec's
+        ``np.frombuffer`` raises on a view shorter than the schema
+        demands.  (Recording the FULL length instead would hand the
+        raw codec a stale-garbage tail that decodes silently into
+        wrong observations — corruption the drill could never see.)
+        The consumer must fail the slot, count it, and move on."""
+        ring = self.inner
+        head = ring._get(shm._HEAD)
+        off = ring._slot_off(head)
+        cut = max(1, len(payload) // 2)
+        shm._Q.pack_into(ring._buf, off, 2 * head + 1)
+        ring._set(shm._HEAD, head + 1)
+        shm._Q.pack_into(ring._buf, off + 8, cut)
+        pos = off + shm._SLOT_HDR
+        ring._buf[pos:pos + cut] = payload[:cut]
+        shm._Q.pack_into(ring._buf, off, 2 * head + 2)
+        self.truncated_injected += 1
+        return True
+
+    def push(self, parts) -> bool:
+        from ..pipeline import shm
+
+        cfg = self.cfg
+        draw = self.rng.random()
+        if draw < (cfg.shm_tear_prob + cfg.shm_full_prob
+                   + cfg.shm_truncate_prob):
+            ring = self.inner
+            if ring._buf is None:
+                return False  # closed: delegate semantics
+            payload = self._parts_bytes(parts)
+            if not self._fits(len(payload), shm):
+                # a genuinely full/oversize ring refuses before any
+                # fault could fire — keep the real refusal (counted)
+                return ring.push(parts)
+            if draw < cfg.shm_tear_prob:
+                return self._tear(payload, shm)
+            draw -= cfg.shm_tear_prob
+            if draw < cfg.shm_full_prob:
+                # forced backpressure, indistinguishable from a full
+                # ring: counted in the header where the peer reads it
+                ring._set(shm._FULL, ring._get(shm._FULL) + 1)
+                self.full_injected += 1
+                return False
+            return self._truncate(payload, shm)
+        return self.inner.push(parts)
+
+    def pop(self, loads=bytes):
+        if self.rng.random() < self.cfg.shm_stall_prob:
+            self.stalls_injected += 1
+            return None  # stalled consumer: the item stays queued
+        return self.inner.pop(loads)
+
+
+class ChaosBoard:
+    """A :class:`~handyrl_tpu.pipeline.shm.ShmBoard` wrapper that
+    withholds or backdates heartbeats: workers watching the board see
+    the beat age out (drop) or jitter old (delay) while the service
+    is, in fact, alive — the exact ambiguity the fallback/self-
+    degradation machinery has to resolve.  Reads delegate untouched.
+    """
+
+    def __init__(self, inner, cfg: ChaosConfig,
+                 rng: Optional[random.Random] = None):
+        self.inner = inner
+        self.cfg = cfg
+        self.rng = rng if rng is not None else random.Random(cfg.seed)
+        self.beats_dropped = 0
+        self.beats_delayed = 0
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def beat(self, epoch=None, now=None):
+        cfg = self.cfg
+        draw = self.rng.random()
+        if draw < cfg.shm_beat_drop_prob:
+            self.beats_dropped += 1
+            return  # withheld: the board's age keeps growing
+        draw -= cfg.shm_beat_drop_prob
+        if draw < cfg.shm_beat_delay_prob:
+            self.beats_delayed += 1
+            now = ((time.monotonic() if now is None else now)
+                   - cfg.shm_beat_delay)
+        self.inner.beat(epoch=epoch, now=now)
+
+
+def maybe_chaos_ring(ring, cfg: Optional[ChaosConfig],
+                     rng: Optional[random.Random] = None):
+    """Wrap ``ring`` in a :class:`ChaosRing` when shm faults are
+    armed; otherwise return it untouched (zero overhead off)."""
+    if cfg is None or not cfg.shm_faults_enabled:
+        return ring
+    return ChaosRing(ring, cfg, rng=rng)
+
+
+def maybe_chaos_board(board, cfg: Optional[ChaosConfig],
+                      rng: Optional[random.Random] = None):
+    """Wrap ``board`` in a :class:`ChaosBoard` when beat faults are
+    armed; otherwise return it untouched."""
+    if cfg is None or not cfg.shm_beat_faults_enabled:
+        return board
+    return ChaosBoard(board, cfg, rng=rng)
 
 
 class ChaosConnection:

@@ -196,14 +196,28 @@ class Worker:
                     resolved[mid] = self.pipeline.wrap(model, mid)
         return {p: resolved[mid] for p, mid in id_by_player.items()}
 
+    def _next_job(self):
+        """One job from the learner — also the pipeline's surge
+        trigger: the shm brownout (``chaos.surge_hold_uploads``) arms
+        off the model ids in the job stream, exactly like the
+        gather's control-plane hold."""
+        job = send_recv(self.conn, ("args", None))
+        if self.pipeline is not None:
+            self.pipeline.note_jobs([job])
+        return job
+
     def _ship(self, verb, payload):
         """One finished payload upstream: episodes ride the shm
         trajectory ring when the pipeline is attached (zero-copy, no
-        ack round trip); everything else — results, and episodes the
-        ring refuses (full/oversize) — takes the control plane."""
+        ack round trip); everything else — results, episodes the ring
+        refuses (full/oversize), and surge-hold overflow — takes the
+        control plane (spills are stamped ``shm_spilled``, counted,
+        never dropped)."""
         if (verb == "episode" and payload is not None
-                and self.pipeline is not None
-                and self.pipeline.push_episode(payload)):
+                and self.pipeline is not None):
+            for episode in self.pipeline.ship_episode(payload):
+                with payload_trace(episode):
+                    send_recv(self.conn, ("episode", episode))
             return
         with payload_trace(payload):
             send_recv(self.conn, (verb, payload))
@@ -244,7 +258,7 @@ class Worker:
         pool = self.pool
         while True:
             while pool.has_free_slot():
-                job = send_recv(self.conn, ("args", None))
+                job = self._next_job()
                 if job is None:
                     # learner is done assigning; finish what's in
                     # flight (the sequential path always ships its
@@ -273,7 +287,7 @@ class Worker:
                 self._run_lockstep()
                 return
             while True:
-                job = send_recv(self.conn, ("args", None))
+                job = self._next_job()
                 if job is None:
                     return
                 self._run_job(job)
@@ -281,6 +295,15 @@ class Worker:
             pass  # learner/gather went away: exit quietly
         finally:
             if self.pipeline is not None:
+                # episodes a surge hold staged must not die with the
+                # worker: drain the backlog into the ring, spill the
+                # rest to the control plane (best effort — a gone
+                # peer can no longer accept anything)
+                try:
+                    for episode in self.pipeline.flush_backlog():
+                        send_recv(self.conn, ("episode", episode))
+                except _PEER_GONE:
+                    pass
                 self.pipeline.close()  # unmap; the learner owns unlink
             telemetry.flush()  # ship the span-log tail before exit
 

@@ -273,11 +273,23 @@ def plot(epochs, out_prefix):
     # the window never spans processes), shm_ring_full_count is the
     # transport's backpressure (climbing = rings undersized, episodes
     # spilling to the control plane), and infer_queue_wait_sec (right
-    # axis) is what the window costs in latency
+    # axis) is what the window costs in latency.  The brownout /
+    # degradation triple rides the same panel: episodes_shm vs
+    # episodes_spilled splits each epoch's intake between the ring
+    # and the control-plane spill (a surge hold shows as a spill
+    # burst, never a dip in their sum), upload_backlog is the deepest
+    # worker-side hold backlog observed, and shm_torn_slots counts
+    # slots reclaimed from producers that died mid-write (flat at 0
+    # outside churn).  All render through series(), so pre-PR-11
+    # metrics files still plot
     inf_cnt_keys = [k for k in ("infer_batch_size_mean",
                                 "infer_batch_size_p95",
                                 "infer_batches",
                                 "shm_ring_full_count",
+                                "shm_torn_slots",
+                                "episodes_shm",
+                                "episodes_spilled",
+                                "upload_backlog",
                                 "infer_respawns")
                     if any(k in e for e in epochs)]
     inf_sec_keys = [k for k in ("infer_queue_wait_sec",)

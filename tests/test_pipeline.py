@@ -161,8 +161,13 @@ def test_request_codec_roundtrip():
 # config validation
 # ---------------------------------------------------------------------
 
-def test_pipeline_config_defaults_off_and_validates():
-    assert not PipelineConfig.from_config({}).enabled
+def test_pipeline_config_defaults_on_and_validates():
+    # the pipelined dataflow IS the mainline: an empty section runs
+    # with the shm transport armed (remote workers and recurrent nets
+    # auto-fall-back); `mode: off` restores the legacy path wholesale
+    assert PipelineConfig.from_config({}).enabled
+    assert PipelineConfig.from_config(None).enabled
+    assert not PipelineConfig.from_config({"mode": "off"}).enabled
     assert PipelineConfig.from_config({"mode": "on"}).enabled
     with pytest.raises(ValueError, match="unknown pipeline keys"):
         PipelineConfig.from_config({"bogus": 1})
@@ -611,6 +616,406 @@ def test_trajectory_ring_feeds_intake_and_spills_when_full():
 
 
 # ---------------------------------------------------------------------
+# shm chaos layer: ChaosRing / ChaosBoard fault injection
+# ---------------------------------------------------------------------
+
+def test_chaos_config_validates_shm_keys():
+    from handyrl_tpu.resilience import ChaosConfig
+
+    cfg = ChaosConfig.from_config({"shm_tear_prob": 0.5,
+                                   "shm_stall_prob": 1.0})
+    assert cfg.shm_faults_enabled
+    assert not ChaosConfig.from_config({}).shm_faults_enabled
+    assert ChaosConfig.from_config(
+        {"shm_beat_drop_prob": 0.1}).shm_beat_faults_enabled
+    with pytest.raises(ValueError, match="shm_tear_prob"):
+        ChaosConfig.from_config({"shm_tear_prob": 1.5})
+    with pytest.raises(ValueError, match="shm_beat_delay"):
+        ChaosConfig.from_config({"shm_beat_delay": -1.0})
+    with pytest.raises(ValueError, match="shm push"):
+        ChaosConfig.from_config({"shm_tear_prob": 0.6,
+                                 "shm_truncate_prob": 0.6})
+    with pytest.raises(ValueError, match="shm beat"):
+        ChaosConfig.from_config({"shm_beat_drop_prob": 0.7,
+                                 "shm_beat_delay_prob": 0.7})
+
+
+def test_chaos_ring_tear_injection_leaves_a_real_torn_slot():
+    """An injected tear is indistinguishable from a producer SIGKILLed
+    mid-RESERVE-THEN-FILL: reservation published (odd stamp, head
+    past it), payload absent — and the standard reclaim applies."""
+    from handyrl_tpu.resilience import ChaosConfig, ChaosRing
+
+    ring = ShmRing.create(slots=4, slot_bytes=64)
+    chaos = ChaosRing(ring, ChaosConfig.from_config(
+        {"shm_tear_prob": 1.0, "seed": 1}))
+    try:
+        assert chaos.push(b"doomed")       # the "producer" died
+        assert chaos.torn_injected == 1
+        assert ring.pending() and not ring.readable()
+        assert ring.pop() is None          # never consumed as data
+        assert ring.skip_torn()            # reclaim
+        assert ring.torn_count == 1
+    finally:
+        ring.close()
+
+
+def test_chaos_ring_full_injection_counts_in_the_header():
+    """Forced backpressure looks exactly like a full ring: refused AND
+    counted where the consumer side reads it (shm header)."""
+    from handyrl_tpu.resilience import ChaosConfig, ChaosRing
+
+    ring = ShmRing.create(slots=4, slot_bytes=64)
+    chaos = ChaosRing(ring, ChaosConfig.from_config(
+        {"shm_full_prob": 1.0, "seed": 1}))
+    try:
+        assert not chaos.push(b"refused")
+        assert chaos.full_injected == 1
+        assert ring.full_count == 1        # consumer-visible
+        assert len(ring) == 0              # nothing landed
+    finally:
+        ring.close()
+
+
+def test_chaos_ring_truncated_payload_is_skipped_not_crashed():
+    """Payload truncation under a complete-looking stamp: the consumer
+    decode fails, the slot is skipped (counted torn) and the ring
+    flows — at the ring level and through the service's drain."""
+    from handyrl_tpu.resilience import ChaosConfig, ChaosRing
+
+    ring = ShmRing.create(slots=4, slot_bytes=1024)
+    chaos = ChaosRing(ring, ChaosConfig.from_config(
+        {"shm_truncate_prob": 1.0, "seed": 1}))
+    try:
+        blob = shm_mod.dumps({"payload": list(range(64))})
+        assert chaos.push(blob)
+        assert chaos.truncated_injected == 1
+        assert ring.readable()             # looks complete...
+        with pytest.raises(Exception):
+            ring.pop(loads=shm_mod.loads_view)  # ...but will not decode
+        assert ring.skip_one()             # the consumer's escape
+        assert ring.torn_count == 1
+        assert ring.push(blob)             # clean producer resumes
+        assert ring.pop(loads=shm_mod.loads_view)["payload"][3] == 3
+    finally:
+        ring.close()
+
+    # RAW request frames detect truncation too: the short view makes
+    # np.frombuffer raise (schema demands more bytes than the slot
+    # holds) — truncation can never decode silently into garbage obs
+    reqring = ShmRing.create(slots=2, slot_bytes=1024)
+    try:
+        chaos2 = ChaosRing(reqring, ChaosConfig.from_config(
+            {"shm_truncate_prob": 1.0, "seed": 1}))
+        assert chaos2.push(shm_mod.pack_request(
+            1, 2, [np.zeros((2, 4), np.float32)]))
+        with pytest.raises(Exception):
+            reqring.pop(loads=lambda v: shm_mod.unpack_request(
+                v, [((4,), "float32")]))
+        assert reqring.skip_one()
+        assert reqring.torn_count == 1
+    finally:
+        reqring.close()
+
+
+def test_service_drain_skips_corrupt_trajectory_slots():
+    """The learner-side degradation ladder for a poisoned slot: the
+    drain counts + skips it and later episodes still arrive — one bad
+    frame never takes the server loop down."""
+    from handyrl_tpu.resilience import ChaosConfig, ChaosRing
+
+    svc, clock, model = _make_service(window=0.0)
+    try:
+        spec = {"leaves": [((2,), "float32")],
+                "example": np.zeros(2, np.float32), "rows_max": 4}
+        desc = svc.attach(spec)
+        traj = ShmRing.attach(**desc["traj"])
+        poison = ChaosRing(traj, ChaosConfig.from_config(
+            {"shm_truncate_prob": 1.0, "seed": 1}))
+        assert poison.push(shm_mod.dumps({"steps": 1}))   # corrupt
+        assert traj.push(shm_mod.dumps({"steps": 2}))     # clean
+        drained = svc.drain_trajectories()
+        assert [ep["steps"] for ep in drained] == [2]
+        assert svc.corrupt == 1
+        assert svc.stats()["corrupt_slots"] == 1
+        assert svc.epoch_stats()["shm_torn_slots"] == 1
+        traj.close()
+    finally:
+        svc.close()
+
+
+def test_chaos_ring_stalled_consumer_backs_the_ring_up():
+    from handyrl_tpu.resilience import ChaosConfig, ChaosRing
+
+    ring = ShmRing.create(slots=4, slot_bytes=64)
+    chaos = ChaosRing(ring, ChaosConfig.from_config(
+        {"shm_stall_prob": 1.0, "seed": 1}))
+    try:
+        assert ring.push(b"waiting")
+        assert ring.readable()
+        assert chaos.pop() is None         # stalled: item stays queued
+        assert chaos.stalls_injected == 1
+        assert len(ring) == 1              # nothing consumed
+        assert ring.pop() == b"waiting"    # a healthy consumer drains
+    finally:
+        ring.close()
+
+
+def test_chaos_board_withholds_and_backdates_beats():
+    from handyrl_tpu.resilience import ChaosBoard, ChaosConfig
+
+    board = ShmBoard.create()
+    try:
+        drop = ChaosBoard(board, ChaosConfig.from_config(
+            {"shm_beat_drop_prob": 1.0, "seed": 1}))
+        drop.beat(epoch=3, now=100.0)
+        assert drop.beats_dropped == 1
+        assert board.age(now=100.0) == float("inf")  # never landed
+
+        delay = ChaosBoard(board, ChaosConfig.from_config(
+            {"shm_beat_delay_prob": 1.0, "shm_beat_delay": 0.5,
+             "seed": 1}))
+        delay.beat(epoch=3, now=100.0)
+        assert delay.beats_delayed == 1
+        assert board.age(now=100.0) == pytest.approx(0.5)  # backdated
+        assert delay.epoch == 3            # reads delegate untouched
+    finally:
+        board.close()
+
+
+# ---------------------------------------------------------------------
+# surge brownout: the worker-side hold / paced drain / spill ladder
+# ---------------------------------------------------------------------
+
+def test_client_surge_hold_stages_paced_drain_and_overflow_spill():
+    """The shm half of `surge_hold_uploads`: during the hold episodes
+    stage in the bounded backlog (overflow spills, stamped + counted);
+    after the hold the drain is paced FIFO (stale first, a small
+    block per shipped episode); the exit flush ships everything —
+    and every episode is accounted for (zero loss)."""
+    from handyrl_tpu.pipeline.config import PipelineConfig
+    from handyrl_tpu.resilience import ChaosConfig
+
+    svc, svc_clock, model = _make_service(window=0.0)
+    try:
+        spec = {"leaves": [((2,), "float32")],
+                "example": np.zeros(2, np.float32), "rows_max": 4}
+        desc = svc.attach(spec)
+        cfg = PipelineConfig.from_config(
+            {"mode": "on", "traj_slots": 4, "traj_slot_mb": 1})
+        chaos = ChaosConfig.from_config(
+            {"surge_epoch": 2, "surge_hold_uploads": 30.0})
+        clock = _FakeClock()
+        client = PipelineClient(desc, cfg, clock=clock,
+                                sleep=clock.sleep, chaos=chaos)
+        try:
+            # pre-surge jobs do not trigger (opponent seats are -1)
+            client.note_jobs([{"model_id": {0: 1, 1: -1}}, None])
+            assert not client.holding()
+            client.note_jobs([{"model_id": {0: 2, 1: 2}}])
+            assert client.holding()
+
+            # 7 episodes during the hold: backlog caps at traj_slots
+            # (4); the 3 oldest spill — stamped, counted, never lost
+            spills = []
+            for i in range(7):
+                spills += client.ship_episode({"i": i})
+            assert [e["i"] for e in spills] == [0, 1, 2]
+            assert all(e["shm_spilled"] for e in spills)
+            assert client.episodes_spilled == 3
+            assert client.episodes_held == 7
+            assert svc.drain_trajectories() == []   # nothing shipped
+
+            # hold passes: the drain is paced FIFO — current episode
+            # joins the tail, a small block ships from the head
+            clock.now = 31.0
+            assert client.ship_episode({"i": 7}) == []
+            drained = svc.drain_trajectories()
+            assert [e["i"] for e in drained] == [3, 4, 5]
+            # shipped-while-backlogged episodes carry the live depth
+            assert drained[0]["upload_backlog"] == 4
+
+            # exit flush: remaining backlog ships over the ring where
+            # it fits, spills the rest — zero loss either way
+            spills2 = client.flush_backlog()
+            drained2 = svc.drain_trajectories()
+            shipped = {e["i"] for e in drained + drained2}
+            spilled = {e["i"] for e in spills + spills2}
+            assert shipped | spilled == set(range(8))
+            assert not shipped & spilled
+            assert (client.episodes_shipped + client.episodes_spilled
+                    == 8)
+        finally:
+            client.close()
+    finally:
+        svc.close()
+
+
+def test_spill_path_under_sustained_full_ring_pressure():
+    """Satellite: the trajectory ring pinned full for a whole epoch —
+    every episode arrives via the control-plane spill with ZERO loss
+    (counts reconcile exactly), `shm_ring_full_count` and
+    `episodes_spilled` both advance, and the drain restores ring
+    shipping."""
+    from handyrl_tpu.resilience import ChaosConfig, ChaosRing
+
+    env, model, svc, client, obs, batch = _real_service()
+    try:
+        # pin the ring "full" for the epoch: every push refused and
+        # counted, exactly what a consumer that never drains causes
+        real_traj = client.traj
+        client.traj = ChaosRing(real_traj, ChaosConfig.from_config(
+            {"shm_full_prob": 1.0, "seed": 3}))
+        spilled = []
+        for i in range(20):
+            spilled += client.ship_episode({"i": i})
+        assert [e["i"] for e in spilled] == list(range(20))
+        assert all(e["shm_spilled"] for e in spilled)
+        assert client.episodes_spilled == 20
+        assert svc.ring_full_count() >= 20       # backpressure, visible
+        assert svc.drain_trajectories() == []    # nothing rode shm
+
+        # the pressure lifts: ring shipping resumes on its own
+        client.traj = real_traj
+        for i in range(20, 30):
+            assert client.ship_episode({"i": i}) == []
+        drained = svc.drain_trajectories()
+        assert [e["i"] for e in drained] == list(range(20, 30))
+        # zero loss: every episode took exactly one of the two paths
+        assert client.episodes_shipped + client.episodes_spilled == 30
+    finally:
+        svc.close()
+        client.close()
+
+
+def test_status_snapshot_exposes_shm_counters():
+    """The status endpoint's pipeline section carries the brownout /
+    degradation counters (torn slots, corrupt slots, shm-vs-spill
+    episode split, hold backlog) next to the serving stats."""
+    from types import SimpleNamespace
+
+    from handyrl_tpu.learner import Learner
+
+    svc, clock, model = _make_service(window=0.0)
+    try:
+        learner = Learner.__new__(Learner)
+        learner.model_epoch = 3
+        learner.episodes_received = 10
+        learner.worker = SimpleNamespace(connection_count=lambda: 0)
+        learner._run_t0 = 0.0
+        learner.fleet = SimpleNamespace(snapshot=lambda: {})
+        learner._last_record = None
+        learner.infer_service = svc
+        learner.episodes_shm = 7
+        learner.episodes_spilled = 3
+        snap = learner._status_snapshot()
+        pipe = snap["pipeline"]
+        assert pipe["episodes_shm"] == 7
+        assert pipe["episodes_spilled"] == 3
+        assert pipe["upload_backlog_peak"] == 0
+        assert pipe["shm_torn_slots"] == 0
+        assert pipe["corrupt_slots"] == 0
+        assert "torn_reclaimed" in pipe and "clients_reaped" in pipe
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------------------
+# real-kill torn-slot regression: SIGKILL a producer mid-slot-write
+# ---------------------------------------------------------------------
+
+class _StallingParts:
+    """A parts sequence for ShmRing.push whose SECOND iteration (the
+    write loop — the first computes the length) writes one chunk,
+    signals the parent, then blocks: push is left mid-RESERVE-THEN-
+    FILL (odd stamp down, head bumped, payload half-written) at the
+    exact moment the parent's SIGKILL lands.  No crafted headers: the
+    REAL producer code path dies a REAL death mid-slot-write."""
+
+    def __init__(self, ready):
+        self.ready = ready
+        self.chunks = [b"A" * 8, b"B" * 8]
+        self.iterations = 0
+
+    def __iter__(self):
+        self.iterations += 1
+        if self.iterations == 1:
+            return iter(self.chunks)       # push's length pass
+        return self._write_pass()
+
+    def _write_pass(self):
+        import time
+
+        yield self.chunks[0]               # half the payload lands
+        self.ready.set()                   # mid-slot-write: kill me
+        time.sleep(600)                    # SIGKILL lands here
+        yield self.chunks[1]               # pragma: no cover
+
+
+def _doomed_producer(desc, ready):
+    """Child process: one complete episode, then a push that stalls
+    mid-slot-write forever (until the parent SIGKILLs it)."""
+    from handyrl_tpu.pipeline import ShmRing
+    from handyrl_tpu.pipeline import shm as child_shm
+
+    ring = ShmRing.attach(**desc)
+    ring.push(child_shm.dumps({"steps": 5}))
+    ring.push(_StallingParts(ready))       # never returns
+
+
+def test_real_producer_sigkill_mid_slot_write_is_reclaimed():
+    """The PR 9 seqlock claim proven against a REAL death: an actual
+    producer process is SIGKILLed mid-slot-write (not a crafted
+    header), and the consumer detects the odd stamp, skips the slot
+    after the grace, counts it, and keeps serving later traffic."""
+    import multiprocessing
+    import os
+    import signal
+
+    ctx = multiprocessing.get_context("spawn")
+    svc, clock, model = _make_service(window=0.0)
+    try:
+        spec = {"leaves": [((2,), "float32")],
+                "example": np.zeros(2, np.float32), "rows_max": 4}
+        desc = svc.attach(spec)
+        ready = ctx.Event()
+        proc = ctx.Process(target=_doomed_producer,
+                           args=(desc["traj"], ready))
+        proc.start()
+        try:
+            assert ready.wait(60), "producer never reached mid-write"
+            os.kill(proc.pid, signal.SIGKILL)   # a real death
+        finally:
+            proc.join(30)
+        assert proc.exitcode == -signal.SIGKILL
+
+        # the complete episode drains; the torn slot stalls the ring
+        drained = svc.drain_trajectories()
+        assert [ep["steps"] for ep in drained] == [5]
+        traj = ShmRing.attach(**desc["traj"])
+        assert traj.pending() and not traj.readable()  # odd stamp
+
+        # within the grace the slot is left alone (a live writer may
+        # still be mid-frame); past it, the reclaim fires and counts
+        assert svc.drain_trajectories() == []
+        assert svc.reclaimed == 0
+        clock.now = svc.TORN_GRACE + 1.0
+        svc.drain_trajectories()
+        assert svc.reclaimed == 1
+        assert traj.torn_count == 1
+        assert svc.epoch_stats()["shm_torn_slots"] == 1
+
+        # training continues: a successor producer ships through the
+        # reclaimed ring and the episode arrives intact
+        assert traj.push(shm_mod.dumps({"steps": 9}))
+        assert [ep["steps"]
+                for ep in svc.drain_trajectories()] == [9]
+        traj.close()
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------------------
 # tier-1 e2e: chaos-kill the inference server mid-train
 # ---------------------------------------------------------------------
 
@@ -640,9 +1045,11 @@ def test_pipelined_training_survives_inference_server_kill(
             "seed": 1, "max_update_compiles": 1,
             "metrics_path": "metrics.jsonl",
             # the subsystem under test: pipelined inference + shm
-            # trajectories, with the service killed at epoch 1 and a
-            # fast fallback so the gap is actually exercised
-            "pipeline": {"mode": "on", "fallback_after": 0.3},
+            # trajectories (mode deliberately OMITTED — the repo-wide
+            # default is `on`, and this e2e proves the default, not a
+            # per-test opt-in), with the service killed at epoch 1 and
+            # a fast fallback so the gap is actually exercised
+            "pipeline": {"fallback_after": 0.3},
             "chaos": {"infer_kill_epoch": 1},
             "respawn_backoff": 0.5,
         },

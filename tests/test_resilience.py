@@ -979,7 +979,7 @@ def test_chaos_surge_lag_spike_absorbed(tmp_path, monkeypatch):
     The learner races through epochs on the stale flood, so intake
     sees a genuine policy-lag spike several epochs high.  Training
     runs `update_algorithm: impact` with a `max_policy_lag` budget of
-    3 and must (a) complete every epoch, (b) record the spike
+    6 and must (a) complete every epoch, (b) record the spike
     (`policy_lag_p95 >= 3` in some epoch), (c) shed the hopeless tail
     (`episodes_rejected_stale > 0` in the records), and (d) keep the
     update step at EXACTLY one compile throughout — the whole point of
@@ -988,25 +988,52 @@ def test_chaos_surge_lag_spike_absorbed(tmp_path, monkeypatch):
     Deliberately in tier-1 (~60s): every knob is pinned (scheduled
     surge, deterministic victims, seeded chaos), and the spike is
     produced by backlog arithmetic (hold seconds x generation rate >>
-    budget x update_episodes), not by timing luck."""
+    budget x update_episodes), not by timing luck.
+
+    SHM-ERA TWIN (PR 11): the pipeline now defaults ON, so this run
+    ships episodes over the shm trajectory rings — and the surge
+    brownout must hold THAT plane too: each worker's PipelineClient
+    stages its hold window in a bounded FIFO backlog and drains it
+    paced, so post-hold intake is stale-first (fresh episodes queue
+    BEHIND the flood, exactly like the gather's control-plane FIFO)
+    and the lag spike survives the transport change.  The
+    reconciliation assertions below prove the brownout sheds
+    delivery, never episodes.  (Sustained full-ring spill pressure
+    has its own deterministic proof in test_pipeline.py — forcing it
+    here would shrink the worker FIFO and dilute the spike with
+    fresh shm arrivals.)"""
     monkeypatch.chdir(tmp_path)
     from handyrl_tpu.learner import Learner
 
     args = _train_args(extra_train={
-        "epochs": 8,
+        # shm-era re-baseline (the transport change the flip is): the
+        # zero-copy drain delivers the flood in seconds, so (a) the
+        # epoch boundary is kept cheap (1 update per epoch) so the
+        # epoch clock advances DURING the intake — the lag arithmetic
+        # is then arrivals/update_episodes by construction instead of
+        # riding this host's training speed; (b) the staleness budget
+        # is 6, making "some epoch consumed at lag in [3, 6]" a
+        # 4-epoch-wide window rather than the single-epoch knife edge
+        # a budget of 3 leaves at shm drain rates; (c) 12 epochs keep
+        # the run alive through the spill drain and into the
+        # rejection phase (lag > 6) that proves the shed
+        "epochs": 12,
         "update_episodes": 4,
         "minimum_episodes": 8,
+        "updates_per_epoch": 1,
         "update_algorithm": "impact",
         "target_update_interval": 16,
-        "max_policy_lag": 3,
+        "max_policy_lag": 6,
         "max_update_compiles": 1,
         "respawn_backoff": 0.2,
         "heartbeat_timeout": 30.0,
         "worker": {"num_parallel": 2, "num_gathers": 2},
+        # NO pipeline section: the repo-wide default (mode on) is what
+        # this drill certifies — no per-test opt-in hides the flip
         "chaos": {"surge_epoch": 2, "surge_kills": 1,
                   "surge_respawn_hold": 1.5,
                   "surge_hold_uploads": 8.0, "seed": 7},
-    }, epochs=8)
+    }, epochs=12)
 
     learner = Learner(args)
     learner.run()
@@ -1021,15 +1048,15 @@ def test_chaos_surge_lag_spike_absorbed(tmp_path, monkeypatch):
 
     # training survived every epoch with a healthy trainer and ONE
     # compiled update step (target net + surrogate inside the jit)
-    assert learner.model_epoch == 8
+    assert learner.model_epoch == 12
     assert learner.trainer.failure is None
     assert learner.trainer.retrace_guard.compiles == 1
 
     records = _read_metrics()
-    assert len(records) == 8
-    # (b) the spike is visible: some epoch consumed data at the full
-    # staleness budget (the budget caps consumed lag at 3, so >= 3
-    # means the drain actually pushed against it)
+    assert len(records) == 12
+    # (b) the spike is visible: some epoch consumed data deep into
+    # the staleness budget (the budget caps consumed lag at 6, so
+    # >= 3 means the drain genuinely pushed the intake off-policy)
     assert max(r["policy_lag_p95"] for r in records) >= 3, (
         [r["policy_lag_p95"] for r in records])
     # (c) the hopeless tail was shed, visibly
@@ -1053,4 +1080,27 @@ def test_chaos_surge_lag_spike_absorbed(tmp_path, monkeypatch):
     assert learner.worker.supervisor.dead_count() == 0
     assert learner.fleet.peak_size == 2
     assert records[-1]["respawns"] >= 1
-    assert os.path.exists("models/8.ckpt")
+    assert os.path.exists("models/12.ckpt")
+
+    # -- the shm-era brownout contract (pipeline defaults ON) --------
+    # episodes rode the rings, and every arrival is accounted for by
+    # the two transport paths (ring-shipped + stamped control-plane
+    # spills) — the surge browns out DELIVERY, it never loses an
+    # episode.  Spills are possible here (hold overflow, full rings)
+    # but not forced; the sustained-pressure spill proof lives in
+    # test_pipeline.py
+    assert learner.infer_service is not None
+    assert learner.episodes_shm > 0
+    assert (learner.episodes_shm + learner.episodes_spilled
+            == learner.episodes_received)
+    # per-epoch visibility: the metric keys ride every record, and the
+    # brownout's paced drain exposed a live worker-side backlog depth
+    for r in records:
+        assert "episodes_shm" in r and "episodes_spilled" in r
+        assert "upload_backlog" in r and "shm_torn_slots" in r
+    # spills recorded per epoch never exceed the cumulative count
+    # (late spills — e.g. a gather's shutdown drain — land after the
+    # final epoch record, so <= rather than ==)
+    assert sum(r["episodes_spilled"] for r in records) \
+        <= learner.episodes_spilled
+    assert max(r["upload_backlog"] for r in records) > 0
