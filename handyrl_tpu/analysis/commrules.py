@@ -209,8 +209,10 @@ def check_unbounded_recv(pkg: Package, mod: ModuleInfo):
     timeout block the calling thread until the peer speaks — and a
     dead, wedged, or partitioned peer never does.  On a fleet that is
     an invisible freeze: no exception, no log line, one thread gone.
-    Quiet when a timeout is passed, when the socket got a
-    ``settimeout`` in the same function, and when the enclosing class
+    Quiet when a timeout is passed, when the receiver (or its
+    ``.sock`` — the framed-connection shape: ``conn.sock.settimeout``
+    bounds ``conn.recv``) got a ``settimeout`` in the same function,
+    and when the enclosing class
     participates in the heartbeat protocol (defines a beat method) —
     its wedges are bounded by the learner's FleetRegistry sweep, which
     evicts and respawns the peer.  Intentional blocking waits carry a
@@ -235,6 +237,15 @@ def check_unbounded_recv(pkg: Package, mod: ModuleInfo):
             if attr in ("recv", "get"):
                 if _bounded_wait(node, attr) or swept:
                     continue
+                if attr == "recv":
+                    # a settimeout on the receiver — or on its .sock,
+                    # the FramedConnection shape — in the same
+                    # function bounds the recv: a silent peer raises
+                    # socket.timeout instead of parking the thread
+                    parts = tuple(dotted_parts(node.func.value) or ())
+                    if parts and (parts in timeout_bases
+                                  or parts + ("sock",) in timeout_bases):
+                        continue
                 what = ("blocking recv()" if attr == "recv"
                         else "blocking Queue.get()")
                 yield Finding(

@@ -232,6 +232,19 @@ class TrainConfig:
     # fallback_after, compress.  Empty = ON (the default since the shm
     # plane earned its chaos pedigree); {mode: 'off'} = legacy path
     pipeline: Dict[str, Any] = field(default_factory=dict)
+    # -- network serving tier (handyrl_tpu.serving) --
+    # SLO-bound, network-facing continuous-batching frontend over the
+    # pipeline inference core: remote clients' requests share the
+    # batching window (and the jitted dispatch) with the colocated shm
+    # workers, with latency histograms + QPS, admission control /
+    # load-shedding under the latency SLO, and multi-model routing for
+    # epoch-pinned requests.  Keys (validated through
+    # ServingConfig.from_config): mode, port, slo_ms, slo_window,
+    # max_inflight, breach_admit_every, reply_timeout, snapshot_cache.
+    # Empty = off (a public port must be an explicit decision);
+    # requires the inference service (pipeline.mode on, local primary
+    # learner).  See docs/serving.md
+    serving: Dict[str, Any] = field(default_factory=dict)
     # -- Anakin mode (handyrl_tpu.anakin; Podracer arXiv:2104.06272) --
     # fused on-device rollout+update for envs with a pure-JAX twin
     # (environment.JAX_ENV_REGISTRY): `mode: on|auto` runs env
@@ -352,7 +365,18 @@ class TrainConfig:
         # inference service and worker-side client run with
         from .pipeline.config import PipelineConfig
 
-        PipelineConfig.from_config(self.pipeline)
+        pipeline_cfg = PipelineConfig.from_config(self.pipeline)
+        # serving keys validate through the dataclass the network
+        # frontend runs with; the service dependency is checked here
+        # because it crosses sections
+        from .serving.config import ServingConfig
+
+        if (ServingConfig.from_config(self.serving).enabled
+                and not pipeline_cfg.enabled):
+            raise ValueError(
+                "serving.mode: on needs the batched inference service "
+                "— it feeds the pipeline batching window, so "
+                "pipeline.mode must be on (the default)")
         # anakin keys validate through the dataclass the fused rollout
         # engine runs with; the epoch-cadence requirement is checked
         # here because it crosses fields

@@ -1467,6 +1467,14 @@ class Learner:
     _infer_disabled = False
     _infer_kill_epoch = 0
     _infer_killed = False
+    # network serving tier (handyrl_tpu.serving): the SLO-bound
+    # frontend feeding remote inference requests into the pipeline
+    # batching window; supervised like the inference service (backoff
+    # respawn + FailureWindow breaker in _serving_tick)
+    serve_frontend = None
+    _serve_respawns = 0
+    _serve_respawn_at = 0.0
+    _serve_disabled = False
     # shm-vs-spill episode accounting (pipelined dataflow): cumulative
     # and per-epoch counts of episodes that rode the trajectory rings
     # vs episodes stamped ``shm_spilled`` (surge-hold overflow / full
@@ -1629,6 +1637,41 @@ class Learner:
                 self.model, self._pipeline_cfg,
                 epoch=self.model_epoch, chaos=chaos_cfg)
             self.infer_service.start()
+        # network serving tier (handyrl_tpu.serving): a framed TCP
+        # frontend whose remote requests join the inference service's
+        # batching window — one jitted dispatch covers the network and
+        # shm planes.  Primary-local only: the frontend needs the
+        # service, and a multihost replica's port would shadow the
+        # primary's.  Death is a supervised fault (_serving_tick)
+        from .serving import ServingConfig
+
+        self._serving_cfg = ServingConfig.from_config(
+            self.args.get("serving") or {})
+        if self._serving_cfg.enabled:
+            if self.infer_service is None or not self.primary:
+                print("WARNING: serving.mode is on but the batched "
+                      "inference service is not running here (pipeline "
+                      "off, remote learner, or non-primary replica); "
+                      "network serving disabled for this process")
+            else:
+                from collections import OrderedDict
+
+                from .resilience.supervisor import FailureWindow
+                from .serving import ServingFrontend
+
+                self._serve_window = FailureWindow(
+                    int(self.args.get("max_respawns", 5)), 60.0)
+                self._serving_snapshots = OrderedDict()
+                # multi-model routing: epoch-pinned network requests
+                # resolve to the exact committed snapshot they asked
+                # for instead of an error or the live model
+                self.infer_service.model_resolver = \
+                    self._resolve_serving_snapshot
+                self.serve_frontend = ServingFrontend(
+                    self.infer_service, self.env, self._serving_cfg,
+                    max_frame_bytes=int(
+                        self.args.get("max_frame_bytes", 0) or 0))
+                self.serve_frontend.start()
         # stall watchdog: the server loop and the communicator's
         # reader/writer threads beat once per pass; a loop silent past
         # max_stall_seconds is a counted stall_event with a stack dump
@@ -1694,6 +1737,11 @@ class Learner:
                 # dashboard never sees a live backlog "vanish" at an
                 # epoch boundary reset
                 "upload_backlog_peak": self._upload_backlog_peak,
+            }
+        if self.serve_frontend is not None:
+            snap["serving"] = {
+                **self.serve_frontend.stats(),
+                "respawns": self._serve_respawns,
             }
         return snap
 
@@ -2125,6 +2173,14 @@ class Learner:
             self._shm_epoch = 0
             self._spilled_epoch = 0
             self._upload_backlog_epoch = 0
+        if self.serve_frontend is not None:
+            # network serving telemetry (docs/observability.md):
+            # per-epoch request/ok/shed/error counts, QPS, and the
+            # log2-histogram latency reduction; serve_shed > 0 is the
+            # admission-control drill's counted proof — sheds are
+            # typed replies, never silent drops
+            record.update(self.serve_frontend.epoch_stats())
+            record["serve_respawns"] = self._serve_respawns
         if self.stall_watchdog is not None:
             # control-plane wedges this epoch (server loop + reader/
             # writer threads silent past max_stall_seconds); steady
@@ -2291,6 +2347,81 @@ class Learner:
             print("inference service respawned "
                   f"(incarnation {svc.board.generation})")
 
+    # -- network serving tier ----------------------------------------
+    def _resolve_serving_snapshot(self, epoch):
+        """epoch -> model for the serving tier's multi-model routing
+        (league/opponent-pool snapshots as first-class serving
+        targets).  Runs on the inference service's thread at dispatch
+        time: the live epoch answers the in-memory model; other epochs
+        load their digest-verified checkpoint once and LRU-cache
+        (``serving.snapshot_cache``), adopting the live model's
+        compiled forward — params are jit arguments, so a routed
+        snapshot costs a file read, never a recompile.  None (a typed
+        error at the frontend) when the epoch was never committed or
+        its file is pruned/corrupt."""
+        if epoch == self.model_epoch:
+            return self.model
+        cache = self._serving_snapshots
+        model = cache.get(epoch)
+        if model is not None:
+            cache.move_to_end(epoch)
+            return model
+        try:
+            params = read_verified(model_path(epoch))["params"]
+        except (OSError, CorruptCheckpointError, pickle.UnpicklingError,
+                EOFError, KeyError):
+            return None  # pruned / never committed / corrupt
+        model = TPUModel(self.model.module, params)
+        try:
+            if hasattr(self.model, "_jitted"):
+                model._jitted = self.model._jitted
+        except Exception:
+            pass
+        cache[epoch] = model
+        while len(cache) > int(self._serving_cfg.snapshot_cache):
+            cache.popitem(last=False)
+        return model
+
+    def _serving_tick(self):
+        """Once per server-loop pass: supervise the serving frontend —
+        a dead acceptor respawns behind backoff and the fleet's
+        windowed circuit breaker (a trip disables network serving for
+        the rest of the run; training is never held hostage by the
+        serving plane)."""
+        fe = self.serve_frontend
+        if (fe is None or fe.alive or self._serve_disabled
+                or self.shutdown_flag):
+            return
+        now = time.monotonic()
+        if self._serve_respawn_at == 0.0:
+            if self._serve_window.record(now):
+                self._serve_disabled = True
+                print("ERROR: the serving frontend keeps dying "
+                      "(circuit breaker tripped); network serving "
+                      "disabled for this run — training continues")
+                fe.close()
+                return
+            delay = float(self.args.get("respawn_backoff", 0.5) or 0.5)
+            self._serve_respawn_at = now + delay
+            print(f"WARNING: serving frontend died; respawning in "
+                  f"{delay:.1f}s (clients see refused connections "
+                  f"meanwhile)")
+        elif now >= self._serve_respawn_at:
+            self._serve_respawn_at = 0.0
+            try:
+                fe.respawn()
+            except Exception as exc:
+                # e.g. a fixed port still held elsewhere: the failure
+                # must cost the serving plane (another ladder round,
+                # eventually the breaker), never the server loop that
+                # keeps training alive
+                print(f"WARNING: serving frontend respawn failed "
+                      f"({exc!r}); retrying through the backoff ladder")
+                return
+            self._serve_respawns += 1
+            print("serving frontend respawned "
+                  f"(incarnation {fe.generation})")
+
     # -- server loop -------------------------------------------------
     def _on_beat(self, beats):
         # liveness bookkeeping happened in the server loop (the
@@ -2338,6 +2469,7 @@ class Learner:
             # run every pass, so pipelined episodes tick the same
             # epoch cadence as control-plane arrivals below
             self._pipeline_tick()
+            self._serving_tick()
 
             if conn is not None:
                 self.fleet.observe(conn, verb, payload)
@@ -2497,6 +2629,10 @@ class Learner:
                 self.stall_watchdog.stop()
             if self.status is not None:
                 self.status.close()
+            if self.serve_frontend is not None:
+                # the frontend rides the service: close it first so no
+                # handler thread submits into a closing service
+                self.serve_frontend.close()
             if self.infer_service is not None:
                 # workers are gone (shutdown drained them): unmap and
                 # unlink every ring this learner created

@@ -33,10 +33,22 @@ Telemetry: every dispatch records an ``infer.batch`` span (rows,
 window wait), and ``epoch_stats`` reduces the epoch's dispatches into
 ``infer_batch_size_{mean,p95}`` / ``infer_queue_wait_sec`` /
 ``shm_ring_full_count`` for metrics.jsonl (docs/observability.md).
+
+**Two planes, one window** (docs/serving.md): besides the shm rings,
+``submit`` queues NETWORK-plane requests (the serving frontend's
+handler threads call it) into the same batching window — a remote
+client's rows and a colocated worker's rows ride one bucket-padded
+jitted forward.  A network request may carry an **epoch pin**:
+``_routed`` resolves it through ``model_resolver`` (set by the
+learner) so league/opponent-pool snapshots are first-class serving
+targets — pinned seats get the snapshot they asked for instead of an
+error or the live model, and since params are jit *arguments* a routed
+snapshot shares the live model's compiled forward (no recompile).
 """
 
 import threading
 import time
+from collections import deque
 
 from .. import telemetry
 from .shm import (
@@ -69,6 +81,15 @@ class _Client:
         self.traj_stuck_since = None
         self.last_seen = 0.0         # last request/trajectory activity
         self.drop_warned = False     # reply-drop warning, once per client
+
+    def deliver(self, seq, epoch, part) -> bool:
+        """Hand one answered request back over the reply ring.  The
+        network-plane seat (serving frontend) implements the same
+        method by waking its handler thread — dispatch is polymorphic
+        over the two planes."""
+        if part is None:
+            return True  # shm requests are never epoch-pinned
+        return self.rsp.push(dumps((seq, epoch, part)))
 
 
 def _bucket(n, cap):
@@ -131,6 +152,18 @@ class InferenceService:
         self._thread = None
         self._stop = False
         self._kill = False           # chaos: die WITHOUT a parting beat
+        # network plane (handyrl_tpu.serving): frontend handler
+        # threads queue requests here via submit(); _collect drains
+        # them into the same batching window as the shm rings.  The
+        # queue belongs to this OBJECT, not the loop thread, so
+        # requests queued across a chaos kill are served by the
+        # respawned incarnation instead of dying with the thread
+        self._net_pending = deque()
+        # epoch pin -> model, set by the learner (multi-model routing:
+        # league/opponent-pool snapshots as serving targets); None
+        # makes every non-live pin unroutable (typed error upstream)
+        self.model_resolver = None
+        self.net_requests = 0        # cumulative network-plane frames
         # counters — epoch accumulators reset by epoch_stats()
         self._batch_rows = []
         self._queue_wait = 0.0
@@ -202,6 +235,26 @@ class InferenceService:
         no in-flight request is ever dropped."""
         with self._lock:
             self._pending_model = (model, int(epoch))
+
+    # -- network plane (serving frontend handler threads) --------------
+    def submit(self, seat, seq, rows, leaves, epoch=None) -> bool:
+        """Queue one network-plane request into the batching window.
+        ``seat`` is the frontend's client duck type (``example`` /
+        ``treedef`` / ``deliver``); ``epoch`` pins the request to a
+        specific snapshot (None = the live model).  False = the
+        service is shut down for good (the frontend sheds with a typed
+        reply).  A merely-dead (killed, pre-respawn) service still
+        accepts: the queue belongs to the object, so these requests
+        are served by the respawned incarnation — the frontend's
+        admission check (``service.alive``) is what sheds NEW arrivals
+        during the gap."""
+        if self._stop:
+            return False
+        with self._lock:
+            self._net_pending.append(
+                (seat, seq, int(rows), leaves,
+                 None if epoch is None else int(epoch)))
+        return True
 
     def inject_kill(self):
         """Chaos: the loop exits without a parting beat — exactly what
@@ -312,6 +365,7 @@ class InferenceService:
             "generation": self.board.generation,
             "batches": self.batches,
             "requests": self.requests,
+            "net_requests": self.net_requests,
             "rows_served": self.rows_served,
             "shm_ring_full_count": self.ring_full_count(),
             "shm_torn_slots": self.torn_slot_count(),
@@ -407,11 +461,18 @@ class InferenceService:
         return jax.tree.unflatten(client.treedef, leaves)
 
     def _collect(self, pending, now):
-        """One sweep over every request ring; appends (client, seq,
-        leaves, rows) tuples.  Returns rows collected this sweep."""
+        """One sweep over every request ring plus the network-plane
+        queue; appends (client, seq, rows, leaves, epoch_pin) tuples.
+        Returns rows collected this sweep."""
         got = 0
         with self._lock:
             clients = list(self._clients.values())
+            net = list(self._net_pending)
+            self._net_pending.clear()
+        for item in net:
+            pending.append(item)
+            got += item[2]
+            self.net_requests += 1
         for c in clients:
             while True:
                 try:
@@ -429,7 +490,7 @@ class InferenceService:
                 c.req_stuck_since = None
                 c.last_seen = self.clock()
                 seq, rows, leaves = item
-                pending.append((c, seq, rows, leaves))
+                pending.append((c, seq, rows, leaves, None))
                 got += rows
         return got
 
@@ -452,63 +513,104 @@ class InferenceService:
                 break
             self.sleep(min(2e-4, deadline - now))
             total += self._collect(pending, self.clock())
-        self._dispatch(pending, total, self.clock() - t_first)
+        self._dispatch(pending, self.clock() - t_first)
         return True
 
-    def _dispatch(self, pending, total, waited):
+    def _routed(self, pin):
+        """(model, epoch) for one dispatch group.  None pins — and
+        pins naming the live snapshot — serve the installed model;
+        other pins resolve through ``model_resolver`` (multi-model
+        routing: league/opponent-pool snapshots as first-class
+        serving targets).  (None, pin) = unroutable, answered as a
+        typed unavailable upstream."""
+        if pin is None or int(pin) == self._epoch:
+            return self._model, self._epoch
+        if self.model_resolver is None:
+            return None, int(pin)
+        try:
+            model = self.model_resolver(int(pin))
+        except Exception as exc:  # a bad pin costs that request only
+            print(f"WARNING: snapshot resolver failed for epoch "
+                  f"{pin} ({exc!r})")
+            model = None
+        return model, int(pin)
+
+    def _dispatch(self, pending, waited):
         import numpy as np
 
         self._adopt_model()
-        model, epoch = self._model, self._epoch
-        # one forward per max_batch chunk (normally exactly one)
-        i = 0
-        while i < len(pending):
-            chunk, rows = [], 0
-            while i < len(pending) and (
-                    rows + pending[i][2] <= self.cfg.max_batch
-                    or not chunk):
-                chunk.append(pending[i])
-                rows += pending[i][2]
-                i += 1
-            t0 = telemetry.span_begin()
-            bucket = _bucket(rows, max(rows, self.cfg.max_batch))
-            leaves = [np.concatenate(parts, axis=0) for parts in zip(
-                *[leaves for _, _, _, leaves in chunk])]
-            if bucket > rows:
-                leaves = [np.concatenate(
-                    [leaf, np.zeros((bucket - rows,) + leaf.shape[1:],
-                                    leaf.dtype)], axis=0)
-                    for leaf in leaves]
-            obs = self._obs_tree(chunk[0][0], leaves)
-            outputs = model.inference_batch(obs, None)
-            outputs.pop("hidden", None)
-            lo = 0
-            for client, seq, n, _ in chunk:
-                part = {k: np.asarray(v[lo:lo + n])
-                        for k, v in outputs.items()}
-                lo += n
-                if not client.rsp.push(dumps((seq, epoch, part))):
-                    # full or too small for the OUTPUT pickle (reply
-                    # slots are sized from the obs schema): the worker
-                    # will time out, count it, and degrade itself to
-                    # local inference — say why, once per client
-                    self.reply_drops += 1
-                    if not client.drop_warned:
-                        client.drop_warned = True
-                        print(f"WARNING: inference reply to client "
-                              f"{client.cid} dropped (reply ring full "
-                              f"or slot smaller than the output "
-                              f"frame); that worker will degrade to "
-                              f"local inference")
-            self.batches += 1
-            self.requests += len(chunk)
-            self.rows_served += rows
-            with self._lock:
-                self._batch_rows.append(rows)
-                self._queue_wait += waited
-                self._requests_epoch += len(chunk)
-            telemetry.span_end("infer.batch", t0, rows=rows,
-                               wait=round(waited, 6), epoch=epoch)
+        # group by epoch pin: the unpinned/live group (the common
+        # case — ALL shm traffic plus unpinned network requests) rides
+        # one bucket-padded forward; each pinned group dispatches with
+        # its routed snapshot's params through the SAME compiled
+        # forward (params are jit arguments — no recompile).  A pin
+        # naming the LIVE epoch normalizes into the unpinned group —
+        # splitting identical-params traffic into two forwards would
+        # re-pay exactly the per-dispatch overhead the shared window
+        # exists to amortize
+        groups = {}
+        for item in pending:
+            pin = item[4]
+            if pin is not None and int(pin) == self._epoch:
+                pin = None
+            groups.setdefault(pin, []).append(item)
+        for pin, items in groups.items():
+            model, epoch = self._routed(pin)
+            if model is None:
+                # unroutable pin (pruned/never-committed epoch, no
+                # resolver): typed unavailable, not a silent timeout
+                for seat, seq, _n, _leaves, _pin in items:
+                    seat.deliver(seq, None, None)
+                continue
+            # one forward per max_batch chunk (normally exactly one)
+            i = 0
+            while i < len(items):
+                chunk, rows = [], 0
+                while i < len(items) and (
+                        rows + items[i][2] <= self.cfg.max_batch
+                        or not chunk):
+                    chunk.append(items[i])
+                    rows += items[i][2]
+                    i += 1
+                t0 = telemetry.span_begin()
+                bucket = _bucket(rows, max(rows, self.cfg.max_batch))
+                leaves = [np.concatenate(parts, axis=0) for parts in zip(
+                    *[leaves for _, _, _, leaves, _ in chunk])]
+                if bucket > rows:
+                    leaves = [np.concatenate(
+                        [leaf, np.zeros((bucket - rows,) + leaf.shape[1:],
+                                        leaf.dtype)], axis=0)
+                        for leaf in leaves]
+                obs = self._obs_tree(chunk[0][0], leaves)
+                outputs = model.inference_batch(obs, None)
+                outputs.pop("hidden", None)
+                lo = 0
+                for client, seq, n, _leaves, _pin in chunk:
+                    part = {k: np.asarray(v[lo:lo + n])
+                            for k, v in outputs.items()}
+                    lo += n
+                    if not client.deliver(seq, epoch, part):
+                        # full or too small for the OUTPUT pickle (reply
+                        # slots are sized from the obs schema): the worker
+                        # will time out, count it, and degrade itself to
+                        # local inference — say why, once per client
+                        self.reply_drops += 1
+                        if not client.drop_warned:
+                            client.drop_warned = True
+                            print(f"WARNING: inference reply to client "
+                                  f"{client.cid} dropped (reply ring full "
+                                  f"or slot smaller than the output "
+                                  f"frame); that worker will degrade to "
+                                  f"local inference")
+                self.batches += 1
+                self.requests += len(chunk)
+                self.rows_served += rows
+                with self._lock:
+                    self._batch_rows.append(rows)
+                    self._queue_wait += waited
+                    self._requests_epoch += len(chunk)
+                telemetry.span_end("infer.batch", t0, rows=rows,
+                                   wait=round(waited, 6), epoch=epoch)
 
     def _warm_next(self):
         """Compile the forward for one pending client's likely batch

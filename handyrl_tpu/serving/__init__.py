@@ -1,0 +1,44 @@
+"""handyrl_tpu.serving — the SLO-bound network serving tier.
+
+A network-facing continuous-batching frontend over the pipeline
+inference core (docs/serving.md): remote clients' requests feed the
+same ``pipeline.InferenceService`` batching window as the colocated
+shm workers, with per-request latency histograms + QPS, SLO-bound
+admission control (typed shed replies, never silent drops), and
+multi-model routing for epoch-pinned requests (league/opponent-pool
+snapshots as first-class serving targets).
+
+Public surface:
+
+  * :class:`.config.ServingConfig` — the validated ``serving.*`` keys;
+  * :class:`.frontend.ServingFrontend` — the learner-side acceptor;
+  * :class:`.client.ServeClient` (+ :class:`.client.ShedError` /
+    :class:`.client.ServeError`) — the consumer SDK.
+
+``ServingConfig`` imports eagerly (config validation reads it without
+jax); the frontend and client resolve lazily (PEP 562) so importing
+the package stays cheap for config-only consumers — the same
+convention as ``handyrl_tpu.anakin``.
+"""
+
+from .config import ServingConfig  # noqa: F401
+
+_LAZY = {
+    "ServingFrontend": ("handyrl_tpu.serving.frontend",
+                        "ServingFrontend"),
+    "ServeClient": ("handyrl_tpu.serving.client", "ServeClient"),
+    "ShedError": ("handyrl_tpu.serving.client", "ShedError"),
+    "ServeError": ("handyrl_tpu.serving.client", "ServeError"),
+}
+
+__all__ = ["ServingConfig", *_LAZY]
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        module, attr = _LAZY[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

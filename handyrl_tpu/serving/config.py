@@ -1,0 +1,100 @@
+"""Typed ``serving.*`` configuration (the network serving-tier knobs).
+
+Validated in one place — the dataclass the serving frontend actually
+runs with — and surfaced to ``config.py`` the same way
+``PipelineConfig`` is: ``TrainConfig.__post_init__`` calls
+:meth:`ServingConfig.from_config` so a bad key or range fails at
+config load.  Every field is documented in docs/parameters.md
+(test_docs-enforced).
+
+No jax imports here: this module is read by config validation before
+any backend pins.
+"""
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+MODES = ("off", "on")
+
+SERVE_PORT = 9995  # next to the worker plane's 9998/9999
+
+
+@dataclass
+class ServingConfig:
+    """Knobs for the network serving tier (``serving:`` section).
+
+    ``mode: on`` opens a framed-protocol TCP frontend on ``port`` that
+    feeds remote inference requests into the SAME batching window as
+    the colocated shm workers (``pipeline.InferenceService``), with
+    per-request latency histograms, QPS, SLO-bound admission control
+    (shed requests get a typed reply, counted, never silently
+    dropped), and multi-model routing for epoch-pinned requests.
+    Default off: a public port must be an explicit decision.  Requires
+    the pipeline's inference service (``pipeline.mode: on``, the
+    default) on a local, primary learner.
+    """
+
+    # off | on — whether the learner opens the network frontend
+    mode: str = "off"
+    # TCP port for the framed serving protocol; 0 = OS-assigned
+    # (ephemeral — the bound port is printed and shown in the status
+    # snapshot, for tests and single-host drives)
+    port: int = SERVE_PORT
+    # p99 latency SLO over the sliding request window, milliseconds;
+    # while the window's p99 exceeds this the frontend SHEDS (typed
+    # "shed" reply, reason "slo") all but a trickle of requests.
+    # 0 = no latency-based shedding
+    slo_ms: float = 100.0
+    # sliding window of completed-request latencies the SLO breach
+    # check runs over (exact samples, not the histogram — admission
+    # must not inherit log2 quantization)
+    slo_window: int = 256
+    # admission cap on concurrently-admitted requests; arrivals past
+    # it shed with reason "overload"
+    max_inflight: int = 256
+    # cap on concurrently-open client connections (each costs one
+    # handler thread); connects past it are closed at accept and
+    # counted — a connection sweep must not grow unbounded threads
+    # next to a training learner
+    max_connections: int = 256
+    # while the SLO is breached, admit every Nth request (the trickle
+    # that lets the window observe recovery) and shed the rest
+    breach_admit_every: int = 4
+    # seconds a handler waits for its batched reply before answering a
+    # typed error (covers a service killed mid-request)
+    reply_timeout: float = 5.0
+    # LRU capacity for routed past-epoch snapshots (multi-model
+    # routing; the live model rides outside this cache)
+    snapshot_cache: int = 4
+
+    @classmethod
+    def from_config(cls, raw: Optional[Dict[str, Any]]) -> "ServingConfig":
+        raw = dict(raw or {})
+        known = {f for f in cls.__dataclass_fields__}
+        unknown = set(raw) - known
+        if unknown:
+            raise ValueError(f"unknown serving keys: {sorted(unknown)}")
+        cfg = cls(**raw)
+        if cfg.mode not in MODES:
+            raise ValueError(f"serving.mode must be one of {MODES}")
+        if cfg.port < 0:
+            raise ValueError("serving.port must be >= 0")
+        if cfg.slo_ms < 0:
+            raise ValueError("serving.slo_ms must be >= 0")
+        if cfg.slo_window < 8:
+            raise ValueError("serving.slo_window must be >= 8")
+        if cfg.max_inflight < 1:
+            raise ValueError("serving.max_inflight must be >= 1")
+        if cfg.max_connections < 1:
+            raise ValueError("serving.max_connections must be >= 1")
+        if cfg.breach_admit_every < 2:
+            raise ValueError("serving.breach_admit_every must be >= 2")
+        if cfg.reply_timeout <= 0:
+            raise ValueError("serving.reply_timeout must be > 0")
+        if cfg.snapshot_cache < 1:
+            raise ValueError("serving.snapshot_cache must be >= 1")
+        return cfg
+
+    @property
+    def enabled(self) -> bool:
+        return self.mode == "on"

@@ -14,9 +14,12 @@ Public surface (see :mod:`.spans` for the design notes):
   * exporters: :mod:`.export` (Perfetto ``trace.json``) and
     :mod:`.status` (read-only HTTP snapshot);
   * metrics: ``summarize_lags`` (the per-epoch policy-version-lag
-    reduction).
+    reduction) and :class:`.histogram.LatencyHistogram` (mergeable
+    fixed-bucket log2 latency histogram — the serving tier's p50/p99
+    accounting, reusable for any span family).
 """
 
+from .histogram import LatencyHistogram  # noqa: F401
 from .spans import (  # noqa: F401
     TRACE_HEAD,
     add_event,

@@ -6,6 +6,12 @@ snapshot — the poll target for dashboards that must not touch the
 control plane (the worker protocol stays workers-only; this socket
 cannot mutate anything: every method but GET is rejected).
 
+``GET /healthz`` answers a constant tiny JSON (``{"ok": true}``)
+WITHOUT invoking the snapshot callable: the liveness probe for load
+balancers fronting the serving tier and for the frontend's own
+supervision — pollers at high frequency must not pay (or race) the
+full snapshot assembly just to learn the process is alive.
+
 Runs a ThreadingHTTPServer on a daemon thread; the snapshot callable is
 invoked per request on the server thread, so it must only read
 (`Learner._status_snapshot` assembles from already-thread-safe
@@ -27,6 +33,15 @@ class StatusServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):
+                if self.path.split("?", 1)[0] == "/healthz":
+                    # liveness only: constant body, no snapshot call
+                    body = b'{"ok": true}'
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 try:
                     body = json.dumps(outer.snapshot_fn()).encode()
                     self.send_response(200)

@@ -685,10 +685,24 @@ class WorkerServer(QueueCommunicator):
     gather connections into the communicator — so machines may join at
     any time during training (elastic scale-out)."""
 
+    # entry-handshake deadline, seconds (class-level so tests can
+    # shrink it: a slow-loris peer should cost ITS deadline, not 10s
+    # of test wall time)
+    ENTRY_TIMEOUT = 10.0
+    # class-level defaults so partially-constructed servers (tests
+    # drive _safe_admit via WorkerServer.__new__) keep working
+    entry_port = ENTRY_PORT
+    _admit_lock = threading.Lock()
+
     def __init__(self, args):
         super().__init__()
         self.args = args
         self.total_worker_count = 0
+        self.entry_port = ENTRY_PORT
+        # id-block reservation guard: entry handshakes run CONCURRENTLY
+        # (one thread each), and two machines joining at once must not
+        # be handed overlapping worker-id blocks
+        self._admit_lock = threading.Lock()
 
     def note_epoch(self, epoch):
         """No supervised fleet here (remote gathers run under their own
@@ -707,8 +721,12 @@ class WorkerServer(QueueCommunicator):
         # jaxlint: disable=unbounded-recv -- bounded: _safe_admit arms a socket deadline before calling, so a silent peer raises timeout instead of wedging the entry loop
         remote_cfg = conn.recv()
         print(f"accepted connection from {remote_cfg['address']}")
-        remote_cfg["base_worker_id"] = self.total_worker_count
-        self.total_worker_count += remote_cfg["num_parallel"]
+        count = int(remote_cfg["num_parallel"])
+        with self._admit_lock:
+            # handshakes run concurrently: the reservation must be
+            # atomic or two joining machines get overlapping id blocks
+            remote_cfg["base_worker_id"] = self.total_worker_count
+            self.total_worker_count += count
         merged = copy.deepcopy(self.args)
         merged["worker"] = remote_cfg
         conn.send(merged)
@@ -728,7 +746,7 @@ class WorkerServer(QueueCommunicator):
             # the whole handshake a deadline, after which the recv in
             # _admit raises socket.timeout (an OSError) and the peer
             # is dropped like any other garbage handshake
-            conn.sock.settimeout(10.0)
+            conn.sock.settimeout(self.ENTRY_TIMEOUT)
             self._admit(conn)
         except Exception as exc:  # noqa: BLE001 — see docstring
             print(f"entry handshake failed ({exc!r}); dropping peer")
@@ -738,11 +756,18 @@ class WorkerServer(QueueCommunicator):
                 pass
 
     def _entry_server(self):
-        print(f"started entry server {ENTRY_PORT}")
+        print(f"started entry server {self.entry_port}")
         for conn in accept_socket_connections(
-                port=ENTRY_PORT, max_frame_bytes=self._max_frame_bytes()):
+                port=self.entry_port,
+                max_frame_bytes=self._max_frame_bytes()):
             if conn is not None:
-                self._safe_admit(conn)
+                # one thread per handshake: admits run CONCURRENTLY,
+                # so a slow-loris (or merely slow) peer costs its own
+                # deadline, never the machines queued behind it — the
+                # accept loop goes straight back to accept()
+                threading.Thread(
+                    target=self._safe_admit, args=(conn,),
+                    daemon=True, name="entry-admit").start()
 
     def _worker_server(self):
         print(f"started worker server {WORKER_PORT}")

@@ -1,0 +1,150 @@
+"""PR 13 smoke drive: the network serving tier on a live training run.
+
+Runs a short local TicTacToe training with `serving.mode: on`, and —
+while it trains — drives the frontend from real network clients:
+unpinned requests served by the live snapshot, an epoch-1-pinned
+request (the league-seat shape) asserted BIT-EQUAL to local inference
+on that checkpoint, a deliberate SLO breach producing typed counted
+sheds, the `stats` verb, and a curl of the status endpoint (incl.
+`/healthz`).  Artifacts land in this directory: train.log (the run's
+stdout), metrics.jsonl with the serve_* keys, status.json, and
+curve_serving.png via scripts/plot_metrics.py.
+
+Run from the repo root:  python runs/pr13_serving_smoke/probe.py
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.getcwd())  # repo root
+
+import numpy as np  # noqa: E402
+
+RUN_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def main():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from handyrl_tpu.connection import find_free_port
+    from handyrl_tpu.durability import read_verified
+    from handyrl_tpu.environment import make_env
+    from handyrl_tpu.learner import Learner
+    from handyrl_tpu.models import TPUModel
+    from handyrl_tpu.serving import ServeClient, ShedError
+
+    work = os.path.join(RUN_DIR, "work")
+    os.makedirs(work, exist_ok=True)
+    os.chdir(work)
+    status_port = find_free_port()
+    args = {
+        "env_args": {"env": "TicTacToe"},
+        "train_args": {
+            "turn_based_training": True, "observation": False,
+            "gamma": 0.8, "forward_steps": 4, "burn_in_steps": 0,
+            "compress_steps": 4, "entropy_regularization": 0.1,
+            "entropy_regularization_decay": 0.1,
+            "update_episodes": 25, "batch_size": 8,
+            "minimum_episodes": 15, "maximum_episodes": 300,
+            "epochs": 5, "num_batchers": 1, "eval_rate": 0.1,
+            "worker": {"num_parallel": 2}, "lambda": 0.7,
+            "policy_target": "VTRACE", "value_target": "VTRACE",
+            "seed": 7, "metrics_path": "metrics.jsonl",
+            "status_port": status_port,
+            # slo_ms 0.5: real requests on this host take 1-5 ms, so
+            # once the window warms the breach drill fires on its own
+            "serving": {"mode": "on", "port": 0, "slo_ms": 0.5,
+                        "slo_window": 8, "breach_admit_every": 4},
+        },
+        "worker_args": {"num_parallel": 2, "server_address": ""},
+    }
+
+    learner = Learner(args)
+    assert learner.serve_frontend is not None
+    port = learner.serve_frontend.port
+    print(f"[probe] serving frontend on :{port}, status on "
+          f":{status_port}")
+    runner = threading.Thread(target=learner.run, daemon=True)
+    runner.start()
+
+    deadline = time.monotonic() + 180
+    while not (learner.model_epoch >= 2
+               and os.path.exists("models/1.ckpt")):
+        assert time.monotonic() < deadline, "epoch 2 never came"
+        assert runner.is_alive(), "learner died early"
+        time.sleep(0.2)
+
+    env = make_env({"env": "TicTacToe"})
+    env.reset()
+    obs = np.asarray(env.observation(env.players()[0]))
+    batch = np.stack([obs] * 8)
+    client = ServeClient("127.0.0.1", port, timeout=10.0)
+
+    # pinned league seat: bit-equal to local inference on epoch 1
+    local = TPUModel(env.net())
+    local.params = read_verified("models/1.ckpt")["params"]
+    expect = local.inference_batch(batch, None)
+    for _ in range(40):
+        try:
+            reply = client.infer_batch(batch, epoch=1)
+            break
+        except ShedError:
+            continue
+    else:
+        raise AssertionError("every pinned request was shed")
+    assert reply["epoch"] == 1
+    assert np.array_equal(np.asarray(reply["outputs"]["policy"]),
+                          np.asarray(expect["policy"]))
+    print("[probe] pinned epoch-1 request BIT-MATCHES local "
+          "inference on models/1.ckpt")
+
+    oks = sheds = 0
+    for _ in range(80):
+        try:
+            client.infer_batch(batch)
+            oks += 1
+        except ShedError as exc:
+            assert exc.reason == "slo"
+            sheds += 1
+    print(f"[probe] SLO breach drill: {oks} ok / {sheds} typed sheds")
+    assert sheds > 0 and oks > 0
+
+    stats = client.stats()
+    assert stats["submitted"] == (stats["ok"] + stats["shed"]
+                                  + stats["errors"])
+    print(f"[probe] stats verb reconciles: {stats['submitted']} "
+          f"submitted == {stats['ok']} ok + {stats['shed']} shed + "
+          f"{stats['errors']} errors")
+
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{status_port}/healthz", timeout=10) as r:
+        assert json.loads(r.read()) == {"ok": True}
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{status_port}/", timeout=10) as r:
+        snap = json.loads(r.read())
+    assert snap["serving"]["shed_by"].get("slo", 0) > 0
+    with open(os.path.join(RUN_DIR, "status.json"), "w") as f:
+        json.dump(snap, f, indent=1)
+    print("[probe] status endpoint snapshot saved (serving section "
+          "present, /healthz 200)")
+
+    client.close()
+    runner.join(timeout=300)
+    assert learner.model_epoch == 5
+    assert learner.trainer.failure is None
+    with open("metrics.jsonl") as f:
+        recs = [json.loads(line) for line in f if line.strip()]
+    assert sum(r["serve_shed"] for r in recs) > 0
+    assert sum(r["serve_requests"] for r in recs) > 0
+    import shutil
+
+    shutil.copy("metrics.jsonl", os.path.join(RUN_DIR, "metrics.jsonl"))
+    print("[probe] DONE: training completed, serve_* keys in "
+          "metrics.jsonl, sheds counted")
+
+
+if __name__ == "__main__":
+    main()

@@ -350,6 +350,40 @@ def plot(epochs, out_prefix):
                     bbox_inches="tight")
         print(f"wrote {out_prefix}_anakin.png")
 
+    # serving tier (handyrl_tpu.serving via the metrics jsonl): the
+    # request/shed/error counts show admission control working (sheds
+    # are typed replies — a shed burst with flat errors is the SLO
+    # doing its job; climbing errors mean timeouts or unroutable
+    # pins), and the latency percentiles ride the right axis in ms.
+    # All render through series(), so pre-serving metrics files plot
+    srv_cnt_keys = [k for k in ("serve_requests", "serve_ok",
+                                "serve_shed", "serve_errors",
+                                "serve_qps", "serve_respawns")
+                    if any(k in e for e in epochs)]
+    srv_ms_keys = [k for k in ("serve_p50_ms", "serve_p99_ms")
+                   if any(k in e for e in epochs)]
+    if srv_cnt_keys or srv_ms_keys:
+        fig, ax = plt.subplots(figsize=(8, 5))
+        for k in srv_cnt_keys:
+            pts = series(xs, epochs, k)
+            if pts:
+                ax.plot(*zip(*pts), label=k, marker=".")
+        ax.set_xlabel("epoch")
+        ax.set_ylabel("requests / outcomes / QPS")
+        ax2 = ax.twinx()
+        for k in srv_ms_keys:
+            pts = series(xs, epochs, k)
+            if pts:
+                ax2.plot(*zip(*pts), label=k, linestyle="--")
+        ax2.set_ylabel("latency, ms")
+        lines, labels = ax.get_legend_handles_labels()
+        lines2, labels2 = ax2.get_legend_handles_labels()
+        ax.legend(lines + lines2, labels + labels2, fontsize=8)
+        ax.grid(alpha=0.3)
+        fig.savefig(out_prefix + "_serving.png", dpi=120,
+                    bbox_inches="tight")
+        print(f"wrote {out_prefix}_serving.png")
+
     # generation stats (mean +- std band)
     pts = [(x, e["generation_mean"], e.get("generation_std", 0.0))
            for x, e in zip(xs, epochs) if "generation_mean" in e]

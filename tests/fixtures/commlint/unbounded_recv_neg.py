@@ -33,6 +33,19 @@ def serve(sock):
         peer.close()
 
 
+def framed_poll(conn, sink):
+    # the framed-connection shape: a settimeout on the underlying
+    # socket bounds the wrapper's recv (socket.timeout raises out)
+    conn.sock.settimeout(1.0)
+    while True:
+        sink.append(conn.recv())    # bounded by conn.sock.settimeout
+
+
+def raw_poll(sock):
+    sock.settimeout(0.5)
+    return sock.recv()              # bounded by settimeout above
+
+
 class Gather:
     """Heartbeat participant: a wedged round trip here is recovered by
     the learner's FleetRegistry sweep, not by a local timeout."""

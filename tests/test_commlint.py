@@ -391,6 +391,12 @@ def test_repo_protocol_graph_is_populated():
     # handshake (pipeline.client sends it via send_recv, the gather
     # forwards it verbatim, learner._on_shm answers the descriptor)
     pipeline_plane = {"shm"}
+    # the network serving tier's request verbs (serving.client sends
+    # them through the ServeClient._call wrapper, the frontend's
+    # per-connection dispatch handles them; replies are bare status
+    # dicts by design — the same shape as every other plane's replies
+    # — so they are deliberately NOT protocol verbs)
+    serving_plane = {"infer", "stats"}
     assert worker_plane <= set(an.sent_verbs), (
         f"worker-plane verbs not discovered as sent: "
         f"{worker_plane - set(an.sent_verbs)}")
@@ -403,11 +409,19 @@ def test_repo_protocol_graph_is_populated():
         f"pipeline verbs not discovered as sent: "
         f"{pipeline_plane - set(an.sent_verbs)}")
     assert pipeline_plane <= set(an.handled_verbs)
-    # round-trip semantics: model fetches and the shm handshake expect
-    # replies, quit is fire-and-forget by protocol (its handler breaks
-    # without a reply)
+    assert serving_plane <= set(an.sent_verbs), (
+        f"serving verbs not discovered as sent: "
+        f"{serving_plane - set(an.sent_verbs)}")
+    assert serving_plane <= set(an.handled_verbs), (
+        f"serving verbs not discovered as handled: "
+        f"{serving_plane - set(an.handled_verbs)}")
+    # round-trip semantics: model fetches, the shm handshake, and both
+    # serving verbs expect replies; quit is fire-and-forget by
+    # protocol (its handler breaks without a reply)
     assert all(s.expects_reply for s in an.sent_verbs["model"])
     assert all(s.expects_reply for s in an.sent_verbs["shm"])
+    assert all(s.expects_reply for s in an.sent_verbs["infer"])
+    assert all(s.expects_reply for s in an.sent_verbs["stats"])
     assert not any(s.expects_reply for s in an.sent_verbs["quit"])
     # episode/result reach their sends through Worker._ship (the
     # ship-or-spill helper between the shm transport and the control

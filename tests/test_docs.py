@@ -10,6 +10,7 @@ from handyrl_tpu.anakin.config import AnakinConfig
 from handyrl_tpu.config import TrainConfig, WorkerConfig
 from handyrl_tpu.pipeline.config import PipelineConfig
 from handyrl_tpu.resilience.chaos import ChaosConfig
+from handyrl_tpu.serving.config import ServingConfig
 
 DOCS = os.path.join(os.path.dirname(__file__), "..", "docs",
                     "parameters.md")
@@ -36,6 +37,8 @@ def _config_keys():
         keys.add(field.name)  # the documented pipeline.* sub-keys
     for field in dataclasses.fields(AnakinConfig):
         keys.add(field.name)  # the documented anakin.* sub-keys
+    for field in dataclasses.fields(ServingConfig):
+        keys.add(field.name)  # the documented serving.* sub-keys
     keys.update({"env", "opponent"})  # env_args.env + eval.opponent
     return keys
 
@@ -55,7 +58,7 @@ def test_no_phantom_keys_documented():
 def test_docs_exist():
     for name in ("api.md", "custom_environment.md",
                  "large_scale_training.md", "observability.md",
-                 "parameters.md", "static_analysis.md"):
+                 "parameters.md", "serving.md", "static_analysis.md"):
         path = os.path.join(os.path.dirname(DOCS), name)
         assert os.path.exists(path), f"missing doc {name}"
 

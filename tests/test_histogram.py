@@ -1,0 +1,80 @@
+"""telemetry.histogram: the mergeable log2 latency histogram the
+serving tier's p50/p99 accounting rides (docs/serving.md)."""
+
+from handyrl_tpu.telemetry.histogram import LatencyHistogram
+
+
+def test_bucket_edges_are_log2():
+    lo = LatencyHistogram.LO_MS
+    assert LatencyHistogram.bucket_index(0.0) == 0
+    assert LatencyHistogram.bucket_index(lo) == 0
+    assert LatencyHistogram.bucket_index(lo * 1.5) == 1
+    assert LatencyHistogram.bucket_index(lo * 2) == 2
+    assert LatencyHistogram.bucket_index(lo * 4 * 0.99) == 2
+    assert LatencyHistogram.bucket_index(lo * 4 * 1.01) == 3
+    # far past the top edge clamps into the last bucket
+    assert LatencyHistogram.bucket_index(1e30) \
+        == LatencyHistogram.BUCKETS - 1
+
+
+def test_percentiles_bound_the_true_quantiles():
+    h = LatencyHistogram()
+    for _ in range(99):
+        h.observe(1.0)       # 99x ~1ms
+    h.observe(900.0)         # one outlier
+    assert h.count == 100
+    # p50's bucket upper edge bounds 1.0 within one power of two
+    assert 1.0 <= h.p50 <= 2.048
+    # p99 rank (99) still lands in the 1ms bucket; the outlier is the
+    # max, reported exactly
+    assert h.p99 <= 2.048
+    assert h.max_ms == 900.0
+    assert h.percentile(1.0) == 900.0
+    assert abs(h.mean - (99 * 1.0 + 900.0) / 100) < 1e-9
+
+
+def test_empty_histogram_is_all_zero():
+    h = LatencyHistogram()
+    assert h.count == 0
+    assert h.p50 == 0.0
+    assert h.p99 == 0.0
+    assert h.max_ms == 0.0
+    assert h.mean == 0.0
+    summary = h.summary(prefix="x_")
+    assert summary == {"x_count": 0, "x_p50_ms": 0.0,
+                       "x_p99_ms": 0.0, "x_max_ms": 0.0}
+
+
+def test_merge_equals_combined_observation():
+    a, b, both = (LatencyHistogram(), LatencyHistogram(),
+                  LatencyHistogram())
+    for i, ms in enumerate([0.2, 1.0, 3.5, 40.0, 900.0, 0.01]):
+        (a if i % 2 else b).observe(ms)
+        both.observe(ms)
+    a.merge(b)
+    assert a.counts == both.counts
+    assert a.count == both.count
+    assert a.max_ms == both.max_ms
+    assert abs(a.sum_ms - both.sum_ms) < 1e-9
+    assert a.p50 == both.p50 and a.p99 == both.p99
+
+
+def test_wire_roundtrip_is_lossless():
+    """to_dict/from_dict: the cross-process merge format (a frontend
+    in another process ships its counts like the span logs ship)."""
+    h = LatencyHistogram()
+    for ms in (0.5, 0.5, 12.0, 250.0):
+        h.observe(ms)
+    back = LatencyHistogram.from_dict(h.to_dict())
+    assert back.counts == h.counts
+    assert back.count == h.count
+    assert back.max_ms == h.max_ms
+    # sparse: only populated buckets ride the wire
+    assert all(int(n) > 0 for n in h.to_dict()["buckets"].values())
+
+
+def test_bad_bucket_count_rejected():
+    import pytest
+
+    with pytest.raises(ValueError):
+        LatencyHistogram(counts=[0, 1, 2])

@@ -336,6 +336,82 @@ def test_entry_server_survives_corrupt_handshake():
     assert server.total_worker_count == 0  # no id block burnt
 
 
+def test_entry_accepts_concurrent_mixed_handshakes_without_wedging():
+    """N SIMULTANEOUS entry handshakes — valid joins, garbage bytes,
+    and slow-loris connect-and-say-nothing peers — must all resolve
+    without wedging the accept loop: admits run one thread each, so a
+    loris costs only ITS deadline while valid machines behind it join
+    promptly, garbage costs its own connection, and the concurrent
+    worker-id-block reservations never overlap (extends the PR 4
+    single-peer hardening above)."""
+    import threading as _threading
+
+    from handyrl_tpu.connection import find_free_port
+    from handyrl_tpu.worker import WorkerServer
+
+    server = WorkerServer.__new__(WorkerServer)
+    QueueCommunicator.__init__(server)
+    server.args = {"seed": 0, "worker": {}}
+    server.total_worker_count = 0
+    server.entry_port = find_free_port()
+    server.ENTRY_TIMEOUT = 0.8  # loris pays this, not 10s of test time
+    _threading.Thread(target=server._entry_server, daemon=True).start()
+
+    def dial_raw():
+        for _ in range(50):  # the listener races the first connect
+            try:
+                return socket.create_connection(
+                    ("127.0.0.1", server.entry_port), timeout=5)
+            except OSError:
+                time.sleep(0.05)
+        raise AssertionError("entry server never came up")
+
+    # slow-loris peers FIRST: they say nothing and hold their sockets
+    loris = [dial_raw() for _ in range(2)]
+    # garbage peers: raw junk bytes where a framed handshake belongs
+    for _ in range(2):
+        g = dial_raw()
+        g.sendall(b"\xff" * 16)
+        g.close()
+
+    merged_lock = _threading.Lock()
+    merged_cfgs = []
+
+    def join(i):
+        from handyrl_tpu.connection import open_socket_connection
+
+        conn = open_socket_connection("127.0.0.1", server.entry_port)
+        conn.send({"address": f"machine-{i}", "num_parallel": 2})
+        merged = conn.recv()
+        conn.close()
+        with merged_lock:
+            merged_cfgs.append(merged["worker"])
+
+    t0 = time.monotonic()
+    joiners = [_threading.Thread(target=join, args=(i,), daemon=True)
+               for i in range(3)]
+    for t in joiners:
+        t.start()
+    for t in joiners:
+        t.join(timeout=10)
+    elapsed = time.monotonic() - t0
+    assert len(merged_cfgs) == 3, "a valid join wedged behind a loris"
+    # concurrent admits: id blocks are disjoint and account exactly
+    assert sorted(c["base_worker_id"] for c in merged_cfgs) == [0, 2, 4]
+    assert server.total_worker_count == 6
+    # the lorises did NOT serialize in front of the valid joins
+    assert elapsed < 5.0
+    # after the deadline passes, the loris slots are reclaimed and a
+    # fresh machine still joins — nothing wedged permanently
+    time.sleep(1.0)
+    join(99)
+    assert len(merged_cfgs) == 4
+    assert server.total_worker_count == 8
+    for sock_ in loris:
+        sock_.close()
+    server.shutdown()
+
+
 def test_learner_shuts_down_when_whole_local_fleet_is_dead():
     """All supervised slots circuit-broken on a single-process local
     run: nothing can rejoin, so the learner must exit cleanly instead

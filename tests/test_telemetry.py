@@ -309,3 +309,38 @@ def test_status_endpoint_serves_live_json():
         assert calls["n"] == 1
     finally:
         server.close()
+
+
+def test_healthz_answers_without_the_snapshot():
+    """GET /healthz is the load-balancer/supervision liveness probe:
+    200 + a constant tiny JSON, WITHOUT invoking the snapshot callable
+    (a high-frequency poller must not pay — or race — full snapshot
+    assembly), while / keeps serving the full document."""
+    from handyrl_tpu.telemetry.status import StatusServer
+
+    calls = {"n": 0}
+
+    def snapshot():
+        calls["n"] += 1
+        return {"epoch": 1}
+
+    server = StatusServer(0, snapshot)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/healthz",
+                timeout=5) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"] == "application/json"
+            assert json.loads(resp.read()) == {"ok": True}
+        assert calls["n"] == 0          # liveness never built a snapshot
+        # query strings route the same way; the full page still works
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/healthz?probe=1",
+                timeout=5) as resp:
+            assert json.loads(resp.read()) == {"ok": True}
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/", timeout=5) as resp:
+            assert json.loads(resp.read()) == {"epoch": 1}
+        assert calls["n"] == 1
+    finally:
+        server.close()
