@@ -726,7 +726,11 @@ def serve_child(mode, seconds=6.0, clients=12):
     reconcile EXACTLY against submitted ones and serving must resume),
     and ``openloop`` (fixed-rate arrivals against a small
     ``max_inflight`` so admission control sheds visibly instead of
-    letting latency collapse)."""
+    letting latency collapse), and ``mesh`` (batched, but the service
+    dispatch runs as ONE GSPMD program over a virtual device mesh —
+    the leg reports the sharded-vs-unsharded dispatch cost at the
+    forward itself; the parent arms 8 fake CPU devices via
+    XLA_FLAGS)."""
     import threading
 
     from handyrl_tpu.connection import force_cpu_jax
@@ -786,7 +790,32 @@ def serve_child(mode, seconds=6.0, clients=12):
         "slo_ms": 0.0,
         "max_inflight": 4 if mode == "openloop" else 256,
     })
-    svc = InferenceService(model, pcfg, epoch=1)
+    mesh = None
+    if mode == "mesh":
+        from handyrl_tpu.parallel import MeshSpec, make_mesh
+
+        n_dev = len(_jax.devices())
+        if n_dev >= 8:
+            mesh = make_mesh(MeshSpec(dp=4, tp=2))
+        elif n_dev >= 2:
+            mesh = make_mesh(MeshSpec(dp=n_dev))
+    svc = InferenceService(model, pcfg, epoch=1, mesh=mesh)
+    mesh_fwd_ms = None
+    if mesh is not None:
+        # the sharded dispatch cost, measured at the service's OWN
+        # guarded forward on the same bucket the batched leg uses —
+        # ratioed against the unsharded bucket forward above.  On this
+        # CPU host the partition overhead is the whole story (no
+        # parallel hardware); on an accelerator mesh the same ratio is
+        # what tensor-sharded serving of too-big nets costs per row
+        rows = _bucket(clients, 64)
+        b = _jax.tree.map(
+            lambda a: np.stack([np.asarray(a)] * rows), obs)
+        svc._forward(model, b)  # compile outside the window
+        t0 = time.perf_counter()
+        for _ in range(40):
+            svc._forward(model, b)
+        mesh_fwd_ms = (time.perf_counter() - t0) / 40 * 1e3
     svc.start()
     frontend = ServingFrontend(svc, env, scfg)
     frontend.start()
@@ -929,6 +958,13 @@ def serve_child(mode, seconds=6.0, clients=12):
         "service_amortization_x": (round(amortization, 2)
                                    if amortization else None),
     }
+    if mode == "mesh":
+        out["mesh_devices"] = svc.stats()["mesh_devices"]
+        out["infer_resharding_copies"] = svc.shard_guard.copies
+        if mesh_fwd_ms is not None and t_bucket:
+            out["mesh_fwd_ms_bucket"] = round(mesh_fwd_ms, 4)
+            out["mesh_dispatch_cost_x"] = round(
+                mesh_fwd_ms / t_bucket, 3)
     if mode == "chaos":
         out["respawns"] = respawns
         out["resumed_after_respawn"] = (
@@ -959,6 +995,13 @@ def serve_main(rounds=2):
                                     extra=["chaos"]),
         "openloop": lambda: _run_child("--serve-child", timeout=600,
                                        extra=["openloop"]),
+        # GSPMD leg: the same batched load, but the dispatch runs as
+        # one sharded program over 8 virtual devices — reports the
+        # sharded-vs-unsharded forward cost (mesh_dispatch_cost_x)
+        "mesh": lambda: _run_child(
+            "--serve-child", timeout=600, extra=["mesh"],
+            env_extra={"XLA_FLAGS":
+                       "--xla_force_host_platform_device_count=8"}),
     })
     ratios = _round_ratios(runs["batched"], runs["unbatched"],
                            key="rps")
@@ -1016,6 +1059,19 @@ def serve_main(rounds=2):
             [r["p99_ms"] for r in openloop if r.get("p99_ms")])
         out["openloop_reconciled"] = all(r.get("reconciled")
                                          for r in openloop)
+    mesh_leg = [r for r in runs.get("mesh", []) if r.get("rps")]
+    if mesh_leg:
+        out["mesh_rps"] = _median([r["rps"] for r in mesh_leg])
+        out["mesh_devices"] = mesh_leg[0].get("mesh_devices")
+        costs = [r["mesh_dispatch_cost_x"] for r in mesh_leg
+                 if r.get("mesh_dispatch_cost_x")]
+        if costs:
+            # sharded/unsharded per-dispatch forward cost at the
+            # bucket (CPU: pure partition overhead; accelerator: what
+            # tensor-sharded serving of a too-big net costs per row)
+            out["mesh_dispatch_cost_x"] = round(_median(costs), 3)
+        out["mesh_resharding_copies"] = max(
+            r.get("infer_resharding_copies", 0) for r in mesh_leg)
     print(json.dumps(out))
 
 
@@ -1060,7 +1116,8 @@ def _anakin_engine(num_envs, seed=3):
     return engine, model
 
 
-def anakin_train_child(epochs=3, num_envs=512, updates_per_epoch=8):
+def anakin_train_child(epochs=3, num_envs=512, updates_per_epoch=8,
+                       mesh=False):
     """Real-Learner training in Anakin mode; emits one JSON line of
     steady-state fused throughput plus the acceptance-guard counters.
 
@@ -1087,6 +1144,10 @@ def anakin_train_child(epochs=3, num_envs=512, updates_per_epoch=8):
                 "worker": {"num_parallel": 1},
                 "max_update_compiles": 1, "max_resharding_copies": 1,
                 "anakin": {"mode": "on", "num_envs": num_envs},
+                # the mesh leg: the fused step runs GSPMD over the
+                # parent-armed virtual devices (dp4 x tp2) — same
+                # guard contract, env axis sharded on dp
+                **({"mesh": {"dp": 4, "tp": 2}} if mesh else {}),
             },
             "worker_args": {"num_parallel": 1, "server_address": ""},
         }
@@ -1219,6 +1280,16 @@ def anakin_main(rounds=3, epochs=3):
                                    extra=[str(epochs)]),
         "fused": lambda: _run_child("--anakin-child", timeout=900,
                                     extra=[str(epochs)]),
+        # GSPMD leg: the SAME fused training over a dp4 x tp2 mesh of
+        # 8 virtual devices — sharded-vs-unsharded dispatch cost on
+        # the fused step (on this CPU host the partition overhead is
+        # the whole number; an accelerator mesh is where dp buys
+        # throughput).  Hard-asserts the same 1-compile/0-reshard
+        # contract as the single-device child
+        "fused_mesh": lambda: _run_child(
+            "--anakin-child", timeout=900, extra=[str(epochs), "mesh"],
+            env_extra={"XLA_FLAGS":
+                       "--xla_force_host_platform_device_count=8"}),
     })
     anakin_fps, host_fps, ratios = [], [], []
     roll_fps, pool_fps = [], []
@@ -1261,6 +1332,21 @@ def anakin_main(rounds=3, epochs=3):
         out["host_pool_frames_per_sec"] = _median(pool_fps)
         out["generation_ceiling_ratio"] = round(
             _median(roll_fps) / _median(pool_fps), 1)
+    mesh_ratios = _round_ratios(runs.get("fused_mesh", []),
+                                runs["fused"],
+                                key="anakin_env_frames_per_sec")
+    mesh_runs = [r for r in runs.get("fused_mesh", [])
+                 if r.get("anakin_env_frames_per_sec")]
+    if mesh_runs:
+        out["anakin_mesh_env_frames_per_sec"] = _median(
+            [r["anakin_env_frames_per_sec"] for r in mesh_runs])
+        out["mesh_resharding_copies"] = max(
+            r.get("resharding_copies", 0) for r in mesh_runs)
+        if mesh_ratios:
+            # sharded/unsharded fused-step throughput within a round:
+            # the dispatch-cost view of the dp4xtp2 mesh on this host
+            out["mesh_vs_single_dispatch_ratio"] = round(
+                _median(mesh_ratios), 3)
     print(json.dumps(out))
 
 
@@ -1692,9 +1778,11 @@ def _round_ratios(num, den, key=None):
     return ratios
 
 
-def _run_child(flag, timeout=1200, extra=()):
+def _run_child(flag, timeout=1200, extra=(), env_extra=None):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    if env_extra:
+        env.update(env_extra)
     proc = subprocess.run(
         [sys.executable, os.path.abspath(__file__), flag, *extra],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
@@ -1935,8 +2023,10 @@ if __name__ == "__main__":
         tail = [a for a in sys.argv[2:] if a.isdigit()]
         serve_main(rounds=int(tail[0]) if tail else 2)
     elif "--anakin-child" in sys.argv:
-        tail = [a for a in sys.argv[2:] if a.isdigit()]
-        anakin_train_child(epochs=int(tail[0]) if tail else 3)
+        tail = sys.argv[sys.argv.index("--anakin-child") + 1:]
+        digits = [a for a in tail if a.isdigit()]
+        anakin_train_child(epochs=int(digits[0]) if digits else 3,
+                           mesh="mesh" in tail)
     elif "--anakin-host-child" in sys.argv:
         tail = [a for a in sys.argv[2:] if a.isdigit()]
         anakin_host_child(epochs=int(tail[0]) if tail else 3)

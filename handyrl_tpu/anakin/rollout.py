@@ -92,11 +92,38 @@ class AnakinEngine:
         self._params_like = params if params is not None else model.params
         self._fsdp = fsdp
         self._rep = self._out = None
+        self._p_shard = self._o_shard = self._pool_shard = None
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            self._rep = NamedSharding(mesh, P())
+            from ..parallel.mesh import param_sharding, replicated
+            from ..parallel.update import opt_state_sharding
+
+            dp = int(mesh.shape["dp"]) or 1
+            if self.num_envs % dp != 0:
+                raise ValueError(
+                    f"anakin.num_envs {self.num_envs} must be "
+                    f"divisible by the mesh dp axis ({dp}): the env "
+                    "axis is the fused step's batch dimension")
+            self._rep = replicated(mesh)
+            # the env axis (games, states, batch rows) lives on dp;
+            # divisibility guarded just above
             self._out = NamedSharding(mesh, P("dp"))
+            # full mesh shardings, not dp-only batch constraints:
+            # params/opt_state per the learner's tp/fsdp rules, and
+            # the opponent pool laid out EXACTLY like the params it
+            # stacks (leading pool axis replicated, each snapshot's
+            # dims on the param spec) — a replicated pool would keep K
+            # full copies per device and defeat fsdp's memory win
+            self._p_shard = param_sharding(mesh, self._params_like,
+                                           fsdp=fsdp)
+            self._o_shard = opt_state_sharding(
+                optimizer, self._params_like, self._p_shard, self._rep)
+            self._pool_shard = jax.tree.map(
+                lambda s: NamedSharding(
+                    mesh, jax.sharding.PartitionSpec(
+                        *((None,) + tuple(s.spec)))),
+                self._p_shard)
         self._refresh = None
 
     # -- host-side state builders (once per run / per epoch) ----------
@@ -124,8 +151,10 @@ class AnakinEngine:
             return ()
         stacked = jax.tree.map(
             lambda a: jnp.stack([jnp.asarray(a)] * self.K), params)
-        if self._rep is not None:
-            stacked = jax.device_put(stacked, self._rep)
+        if self._pool_shard is not None:
+            # pool leaves land on the param layout (leading stack axis
+            # replicated), so the fused step never reshards them
+            stacked = jax.device_put(stacked, self._pool_shard)
         return stacked
 
     def refresh_pool(self, pool, params):
@@ -143,11 +172,24 @@ class AnakinEngine:
 
             self._refresh = jax.jit(
                 shift, donate_argnums=0,
-                **({} if self._rep is None
-                   else {"out_shardings": self._rep}))
+                **({} if self._pool_shard is None
+                   else {"out_shardings": self._pool_shard}))
         return self._refresh(pool, params)
 
     # -- the fused program --------------------------------------------
+
+    def _stage_env(self, states):
+        """Pin the vmapped env state onto the dp axis (every leaf has
+        the game axis leading; ``num_envs % dp`` guarded at build).
+        Without the constraint GSPMD usually infers the same layout
+        from the batch constraint downstream, but *usually* is not a
+        contract — an inference flip mid-scan would insert per-step
+        resharding collectives."""
+        if self._out is None:
+            return states
+        return jax.tree.map(
+            lambda a: jax.lax.with_sharding_constraint(a, self._out),
+            states)
 
     def _rollout(self, params, pool, carry):
         """One traced segment: reset -> scan unroll steps -> batch.
@@ -166,6 +208,7 @@ class AnakinEngine:
         # both seats see both roles whatever the group layout
         learner_seat = (jnp.arange(N, dtype=jnp.int32) + seg) % 2
         states = jax.vmap(env.init)(jax.random.split(k_init, N))
+        states = self._stage_env(states)
 
         def scan_step(states, step_key):
             active = ~jax.vmap(env.terminal)(states)
@@ -209,6 +252,7 @@ class AnakinEngine:
             env_keys = jax.random.split(k_env, N)
             states, _, _, _, _ = jax.vmap(env.step)(
                 states, action, env_keys)
+            states = self._stage_env(states)
             value_rec = (jnp.zeros(N, jnp.float32) if value is None
                          else value[:, 0])
             rec = {
@@ -316,24 +360,24 @@ class AnakinEngine:
                 return jax.jit(step, donate_argnums=(0, 1, 2, 4))
             return jax.jit(step, donate_argnums=(0, 1, 2))
 
-        from ..parallel.mesh import param_sharding, replicated
-        from ..parallel.update import opt_state_sharding
-
-        p_shard = param_sharding(self._mesh, self._params_like,
-                                 fsdp=self._fsdp)
-        rep = replicated(self._mesh)
-        o_shard = opt_state_sharding(
-            self.optimizer, self._params_like, p_shard, rep)
+        # full mesh shardings computed at build (engine __init__):
+        # params/opt_state per the learner's tp/fsdp rules, the pool
+        # on the param layout behind its stack axis, and the tiny PRNG
+        # carry replicated (env state is segment-local — every game
+        # resets at segment start, so nothing env-shaped persists in
+        # the carry; the in-scan dp constraints pin the live states)
+        p_shard, o_shard, rep = self._p_shard, self._o_shard, self._rep
+        pool_in = self._pool_shard if self.K else rep
         if impact:
             return jax.jit(
                 step,
-                in_shardings=(p_shard, o_shard, rep, rep, p_shard),
+                in_shardings=(p_shard, o_shard, rep, pool_in, p_shard),
                 out_shardings=(p_shard, o_shard, rep, rep, p_shard),
                 donate_argnums=(0, 1, 2, 4),
             )
         return jax.jit(
             step,
-            in_shardings=(p_shard, o_shard, rep, rep),
+            in_shardings=(p_shard, o_shard, rep, pool_in),
             out_shardings=(p_shard, o_shard, rep, rep),
             donate_argnums=(0, 1, 2),
         )

@@ -13,7 +13,10 @@ plain pattern matching cannot give:
     ``NamedSharding(mesh, ...)``, ``jax.device_put(x, s)``,
     ``with_sharding_constraint`` and the return summaries of internal
     builders like ``replicated``/``batch_sharding`` — looked up through
-    closures, so a nested ``stage_time`` sees its builder's bindings);
+    closures, so a nested ``stage_time`` sees its builder's bindings;
+    builders returning a BUNDLE of shardings (the
+    ``inference_shardings`` NamedTuple) summarize per-field, and
+    ``shards.obs``/``shards["obs"]`` resolve through the summary);
   * which functions run inside a ``shard_map``/``pmap`` body, and over
     which axes does that entry actually shard its inputs?  (worklist
     over the jaxlint call graph, including function-valued arguments);
@@ -113,6 +116,19 @@ class SpecFact:
         return self.sig is not None
 
 
+@dataclass(eq=True)
+class SpecStruct:
+    """Field -> :class:`SpecFact` for a builder that returns a BUNDLE
+    of shardings (the ``parallel.mesh.inference_shardings`` shape: a
+    NamedTuple/dict of per-role specs).  Attribute access
+    (``shards.obs``) and string subscripts (``shards["obs"]``) resolve
+    through it, so the PartitionSpec environments of struct-returning
+    builders flow interprocedurally into jit contracts exactly like
+    single-spec builder summaries do."""
+
+    fields: Dict[str, SpecFact]
+
+
 @dataclass
 class ShardJit:
     """A jit value with a sharding contract (``in_shardings`` +
@@ -206,6 +222,8 @@ class ShardAnalysis:
         self.env: Dict[FunctionInfo, Dict[str, object]] = {}
         self.spec_returns: Dict[FunctionInfo, SpecFact] = {}
         self.jit_returns: Dict[FunctionInfo, ShardJit] = {}
+        # builders returning a BUNDLE of shardings (inference_shardings)
+        self.struct_returns: Dict[FunctionInfo, Dict[str, SpecFact]] = {}
         # host-divergence facts
         self.divergent_locals: Dict[FunctionInfo, Set[str]] = {}
         self.divergent_params: Dict[FunctionInfo, Set[str]] = {}
@@ -305,6 +323,22 @@ class ShardAnalysis:
         if isinstance(expr, ast.Name):
             fact = self.lookup(scope, expr.id)
             return fact if isinstance(fact, SpecFact) else None
+        if isinstance(expr, ast.Attribute):
+            # a field of a spec-struct builder result: shards.obs
+            struct = self.resolve_struct(mod, scope, expr.value)
+            if struct is not None:
+                fact = struct.fields.get(expr.attr)
+                return fact if isinstance(fact, SpecFact) else None
+            return None
+        if isinstance(expr, ast.Subscript):
+            key = expr.slice
+            if isinstance(key, ast.Constant) and isinstance(
+                    key.value, str):
+                struct = self.resolve_struct(mod, scope, expr.value)
+                if struct is not None:
+                    fact = struct.fields.get(key.value)
+                    return fact if isinstance(fact, SpecFact) else None
+            return None
         if not isinstance(expr, ast.Call):
             return None
         name = self.pkg.full_name(mod, scope, expr.func)
@@ -325,6 +359,42 @@ class ShardAnalysis:
         res = self.pkg.resolve_callee(mod, scope, expr.func)
         if res is not None and res[0] == "fn":
             return self.spec_returns.get(res[1])
+        return None
+
+    def resolve_struct(self, mod: ModuleInfo, scope, expr) \
+            -> Optional[SpecStruct]:
+        """SpecStruct denoted by an expression: a name bound to one, a
+        constructor/dict whose entries resolve to specs, or a call
+        into a struct-returning builder (summary lookup — the
+        interprocedural leg of the inference-shardings contract)."""
+        if isinstance(expr, ast.Name):
+            fact = self.lookup(scope, expr.id)
+            return fact if isinstance(fact, SpecStruct) else None
+        if isinstance(expr, ast.Dict):
+            fields = {}
+            for key, value in zip(expr.keys, expr.values):
+                if isinstance(key, ast.Constant) and isinstance(
+                        key.value, str):
+                    fact = self.resolve_spec(mod, scope, value)
+                    if fact is not None:
+                        fields[key.value] = fact
+            return SpecStruct(fields) if fields else None
+        if not isinstance(expr, ast.Call):
+            return None
+        fields = {}
+        for kw in expr.keywords:
+            if kw.arg is None:
+                continue
+            fact = self.resolve_spec(mod, scope, kw.value)
+            if fact is not None:
+                fields[kw.arg] = fact
+        if fields:
+            return SpecStruct(fields)
+        res = self.pkg.resolve_callee(mod, scope, expr.func)
+        if res is not None and res[0] == "fn":
+            summary = self.struct_returns.get(res[1])
+            if summary:
+                return SpecStruct(dict(summary))
         return None
 
     def _resolve_jit(self, mod: ModuleInfo, scope, expr) \
@@ -368,6 +438,7 @@ class ShardAnalysis:
                 env: Dict[str, object] = {}
                 returns_spec: List[Optional[SpecFact]] = []
                 returns_jit: Optional[ShardJit] = None
+                returns_struct: List[Optional[SpecStruct]] = []
                 mod = fn.module
 
                 def visit(node):
@@ -387,9 +458,16 @@ class ShardAnalysis:
                             jit = self._resolve_jit(mod, fn, node.value)
                             if jit is not None:
                                 env[tgt] = jit
+                            else:
+                                struct = self.resolve_struct(
+                                    mod, fn, node.value)
+                                if struct is not None:
+                                    env[tgt] = struct
                     elif isinstance(node, ast.Return) \
                             and node.value is not None:
                         returns_spec.append(self.resolve_spec(
+                            mod, fn, node.value))
+                        returns_struct.append(self.resolve_struct(
                             mod, fn, node.value))
                         if returns_jit is None:
                             returns_jit = self._resolve_jit(
@@ -418,6 +496,19 @@ class ShardAnalysis:
                     if joined is not None \
                             and self.spec_returns.get(fn) != joined:
                         self.spec_returns[fn] = joined
+                        changed = True
+                known_structs = [r for r in returns_struct
+                                 if r is not None]
+                if known_structs and len(known_structs) == len(
+                        returns_struct):
+                    joined_struct = known_structs[0] if all(
+                        r == known_structs[0]
+                        for r in known_structs) else None
+                    if joined_struct is not None \
+                            and self.struct_returns.get(fn) \
+                            != joined_struct.fields:
+                        self.struct_returns[fn] = dict(
+                            joined_struct.fields)
                         changed = True
                 if returns_jit is not None \
                         and fn not in self.jit_returns:

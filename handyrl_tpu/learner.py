@@ -1633,9 +1633,24 @@ class Learner:
 
             self._infer_window = FailureWindow(
                 int(self.args.get("max_respawns", 5)), 60.0)
+            # GSPMD inference (ROADMAP item 2): the dispatch inherits
+            # the TRAINING mesh, so one sharded program serves every
+            # actor and network client with params on the learner's
+            # tp/fsdp layout.  Multi-host replicas keep the unsharded
+            # dispatch: each replica's service answers only its own
+            # local workers, and a jit over the global mesh would need
+            # every process in each forward (pod-scale inference rides
+            # ROADMAP item 5's multihost work)
+            infer_mesh = None
+            if (self._pipeline_cfg.infer_mesh == "auto"
+                    and not self.multihost):
+                infer_mesh = self.trainer.train_mesh
             self.infer_service = InferenceService(
                 self.model, self._pipeline_cfg,
-                epoch=self.model_epoch, chaos=chaos_cfg)
+                epoch=self.model_epoch, chaos=chaos_cfg,
+                mesh=infer_mesh, fsdp=self.trainer.train_fsdp,
+                max_reshard=int(
+                    self.args.get("max_resharding_copies", 0) or 0))
             self.infer_service.start()
         # network serving tier (handyrl_tpu.serving): a framed TCP
         # frontend whose remote requests join the inference service's

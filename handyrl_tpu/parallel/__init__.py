@@ -20,8 +20,10 @@ host over a single global mesh.
 """
 
 from .mesh import (
+    InferenceShardings,
     MeshSpec,
     batch_sharding,
+    inference_shardings,
     make_mesh,
     param_sharding,
     replicated,
@@ -36,9 +38,11 @@ from .multihost import (
 )
 
 __all__ = [
+    "InferenceShardings",
     "MeshSpec",
     "make_mesh",
     "batch_sharding",
+    "inference_shardings",
     "param_sharding",
     "replicated",
     "make_sharded_update_step",

@@ -7,7 +7,7 @@ and let XLA insert the collectives.
 """
 
 from dataclasses import dataclass, field
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Any, Dict, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -65,8 +65,20 @@ def make_mesh(spec: Optional[MeshSpec] = None, devices=None) -> Mesh:
         spec = MeshSpec(dp=len(devices))
     if spec.size > len(devices):
         raise ValueError(
-            f"mesh {spec.shape()} needs {spec.size} devices, have {len(devices)}"
+            f"mesh {spec.shape()} needs {spec.size} devices, have "
+            f"{len(devices)} — shrink the `mesh:` config axes "
+            f"(dp/sp/tp) to fit the host, or launch with more devices"
         )
+    if len(devices) % spec.size != 0:
+        # a mesh that doesn't tile the host silently idles the
+        # remainder.  Reached by an explicit `mesh:` shape OR by the
+        # learner's batch-divisor default (e.g. batch 6 on 8 devices
+        # -> dp=6), so the advice names both knobs
+        print(f"WARNING: mesh {spec.shape()} uses {spec.size} of "
+              f"{len(devices)} devices ({len(devices) - spec.size} "
+              f"idle); set an explicit `mesh:` whose axes multiply to "
+              f"a divisor of the device count (or make batch_size "
+              f"divide evenly) to cover the host")
     dev_array = np.asarray(devices[:spec.size]).reshape(spec.shape())
     return Mesh(dev_array, AXES)
 
@@ -125,6 +137,44 @@ def _fsdp_spec_for(shape: Tuple[int, ...], dp_size: int,
             spec[axis] = "dp"
             return P(*spec)
     return taken
+
+
+class InferenceShardings(NamedTuple):
+    """The GSPMD contract of one batched inference dispatch.
+
+    ``params`` per the :func:`param_sharding` tp/fsdp rules (so a net
+    too big for one chip serves from the same layout it trains on),
+    the observation batch split over ``dp`` rows, and the outputs
+    scattered back on the same ``dp`` rows.  Built once per model
+    structure; the service's jitted ``inference_batch`` passes these
+    straight to ``jit(in_shardings=..., out_shardings=...)``.
+    """
+
+    params: Any
+    obs: NamedSharding
+    out: NamedSharding
+
+
+def inference_shardings(mesh: Mesh, params, min_tp_dim: int = 128,
+                        fsdp: bool = False,
+                        min_fsdp_size: int = 4096) -> InferenceShardings:
+    """Shardings for the batched inference forward over ``mesh``.
+
+    One GSPMD program serves every actor and network client: params
+    shard exactly like the learner's (:func:`param_sharding`, incl.
+    the fsdp rule), each observation leaf splits its leading batch dim
+    over ``dp``, and every output leaf comes back scattered on
+    ``dp`` — a single-device mesh collapses all three to the
+    unsharded layout, so the sharded dispatch is bit-identical there
+    by construction.  The batch divisibility contract lives at the
+    service (buckets are powers of two with a floor >= dp).
+    """
+    return InferenceShardings(
+        params=param_sharding(mesh, params, min_tp_dim=min_tp_dim,
+                              fsdp=fsdp, min_fsdp_size=min_fsdp_size),
+        obs=NamedSharding(mesh, P("dp")),
+        out=NamedSharding(mesh, P("dp")),
+    )
 
 
 def param_sharding(mesh: Mesh, params, min_tp_dim: int = 128,

@@ -70,6 +70,13 @@ class PipelineConfig:
     # (the legacy wire format).  Off by default: shm bandwidth is
     # free, so raw pickle blocks skip the bz2 CPU cost on both ends
     compress: bool = False
+    # "auto" builds the jitted inference dispatch over the learner's
+    # training mesh when one is engaged (GSPMD inference: params per
+    # the tp/fsdp rules, batch rows on dp — nets too big for one chip
+    # become servable); "off" keeps the dispatch unsharded whatever
+    # the training mesh.  Single-device (or mesh-less) learners are
+    # identical either way
+    infer_mesh: str = "auto"
 
     @classmethod
     def from_config(cls, raw: Optional[Dict[str, Any]]) -> "PipelineConfig":
@@ -85,6 +92,9 @@ class PipelineConfig:
         if cfg.fallback not in FALLBACKS:
             raise ValueError(
                 f"pipeline.fallback must be one of {FALLBACKS}")
+        if cfg.infer_mesh not in ("auto", "off"):
+            raise ValueError(
+                "pipeline.infer_mesh must be 'auto' or 'off'")
         if cfg.batch_window < 0:
             raise ValueError("pipeline.batch_window must be >= 0")
         if cfg.max_batch < 1:

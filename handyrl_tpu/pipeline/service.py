@@ -44,6 +44,24 @@ learner) so league/opponent-pool snapshots are first-class serving
 targets — pinned seats get the snapshot they asked for instead of an
 error or the live model, and since params are jit *arguments* a routed
 snapshot shares the live model's compiled forward (no recompile).
+
+**GSPMD dispatch** (ROADMAP item 2): with a ``mesh`` the service owns
+ONE jitted forward built with ``in_shardings``/``out_shardings`` from
+:func:`parallel.mesh.inference_shardings` — params laid out by the
+learner's tp/fsdp rules (nets too big for one chip become servable),
+the observation batch split over ``dp`` rows, outputs scattered back
+on ``dp``.  Params stay jit *arguments*: each snapshot (live or
+routed) is ``device_put`` onto the param shardings ONCE and cached on
+the model object, so hot-swap and multi-model routing never pay a
+per-request reshard.  The dispatch rides the same guard contract as
+the update step: a :class:`analysis.guards.ShardingContractGuard`
+counts resharding copies (``infer_resharding_copies`` in
+metrics.jsonl, steady state 0) and a RetraceGuard counts compiles
+(``infer_compiles`` — exactly one per batch-bucket geometry, however
+many snapshots serve through it).  A single-device mesh (or no mesh)
+collapses to the unsharded layout bit-identically; batch buckets stay
+powers of two with a floor >= dp so every dispatch divides the data
+axis.
 """
 
 import threading
@@ -92,10 +110,11 @@ class _Client:
         return self.rsp.push(dumps((seq, epoch, part)))
 
 
-def _bucket(n, cap):
-    """Pad target for an n-row batch: next power of two, floor 8,
-    ceiling ``cap`` — a handful of compiled shapes total."""
-    b = 8
+def _bucket(n, cap, floor=8):
+    """Pad target for an n-row batch: next power of two, floor
+    ``floor`` (8, or the mesh dp size when larger), ceiling ``cap`` —
+    a handful of compiled shapes total, every one divisible by dp."""
+    b = floor
     while b < n:
         b <<= 1
     return min(b, cap)
@@ -122,12 +141,49 @@ class InferenceService:
     GRAVE_GRACE = 10.0  # close only after in-flight snapshots expire
 
     def __init__(self, model, cfg, epoch=0, clock=time.monotonic,
-                 sleep=time.sleep, chaos=None):
+                 sleep=time.sleep, chaos=None, mesh=None, fsdp=False,
+                 max_reshard=0):
         import random
 
+        from ..analysis.guards import RetraceGuard, ShardingContractGuard
         from ..resilience.chaos import maybe_chaos_board
 
         self.cfg = cfg
+        # GSPMD dispatch (module docstring): the learner passes its
+        # training mesh so one sharded program serves all planes.  The
+        # pow2 bucket floor must divide by dp so every dispatch splits
+        # the data axis evenly — a dp the buckets cannot honor disarms
+        # the mesh LOUDLY (unsharded dispatch, never a trace error)
+        self._mesh = None
+        self._fsdp = bool(fsdp)
+        self._bucket_floor = 8
+        if mesh is not None:
+            dp = int(mesh.shape["dp"]) or 1
+            floor = self._bucket_floor
+            if dp > floor and dp & (dp - 1) == 0:
+                floor = dp  # pow2 dp above the floor: raise the floor
+            # every bucket value the dispatch can produce — the pow2
+            # ladder from the floor, clamped at max_batch — must
+            # divide by dp (oversized chunks pad to a full pow2)
+            if (floor % dp == 0 and int(cfg.max_batch) % dp == 0
+                    and floor <= int(cfg.max_batch)):
+                self._mesh = mesh
+                self._bucket_floor = floor
+            else:
+                print(f"WARNING: inference mesh disarmed: dp={dp} "
+                      f"does not divide the pow2 batch buckets "
+                      f"(floor {floor}, max_batch {cfg.max_batch}); "
+                      f"inference dispatch runs unsharded")
+        # guard contract, same as the update step's: compiles counted
+        # per abstract geometry (one per batch bucket, NOT per
+        # snapshot), resharding copies at the call boundary budgeted
+        # at copies == 0 steady state (max_reshard > 0 hard-asserts)
+        self.retrace_guard = RetraceGuard(name="inference_batch")
+        self.shard_guard = ShardingContractGuard(
+            max_copies=int(max_reshard or 0), name="inference_batch")
+        self._fwd = None           # the service-owned guarded jit
+        self._fwd_module = None    # the module it was traced for
+        self._infer_sh = None      # InferenceShardings when mesh-armed
         self.clock = clock
         self.sleep = sleep
         self._lock = threading.Lock()
@@ -337,6 +393,14 @@ class InferenceService:
         out = {
             "infer_batches": len(rows),
             "infer_requests": requests,
+            # the dispatch's guard contract (module docstring): copies
+            # is a per-epoch delta whose steady state is 0 — any
+            # positive count means a snapshot landed on the wrong
+            # layout and every forward pays a silent copy; compiles is
+            # cumulative and stops growing once every bucket geometry
+            # has compiled (snapshots never add one)
+            "infer_resharding_copies": self.shard_guard.snapshot(),
+            "infer_compiles": self.retrace_guard.compiles,
             "shm_ring_full_count": self.ring_full_count(),
             # torn/corrupt slots skipped, cumulative, read from the
             # shm headers (covers both endpoints' skips).  Steady
@@ -373,6 +437,10 @@ class InferenceService:
             "corrupt_slots": self.corrupt,
             "reply_drops": self.reply_drops,
             "clients_reaped": self.reaped,
+            "infer_resharding_copies": self.shard_guard.copies,
+            "infer_compiles": self.retrace_guard.compiles,
+            "mesh_devices": (int(self._mesh.size)
+                             if self._mesh is not None else 1),
         }
 
     # -- trajectory intake (learner server thread) ---------------------
@@ -440,16 +508,10 @@ class InferenceService:
         if pending is None:
             return
         model, epoch = pending
-        prev = self._model
-        # keep the compiled forward across the swap (params are jit
-        # arguments, so the trace is weight-independent) — the same
-        # adoption trick the worker-side ModelCache uses
-        try:
-            if (prev is not None and hasattr(prev, "module")
-                    and prev.module == model.module):
-                model._jitted = prev._jitted
-        except Exception:
-            pass
+        # the compiled forward survives the swap in _ensure_forward
+        # (the service-owned jit is cached by module EQUALITY and
+        # params are jit arguments); duck models without a module
+        # carry their own inference_batch and need no adoption
         self._model = model
         self._epoch = epoch
 
@@ -459,6 +521,80 @@ class InferenceService:
         if client.treedef is None:
             client.treedef = jax.tree.structure(client.example)
         return jax.tree.unflatten(client.treedef, leaves)
+
+    # -- the guarded (and, with a mesh, GSPMD) forward -----------------
+    def _ensure_forward(self, model):
+        """The service-owned jitted ``inference_batch``, built once per
+        module and shared by every snapshot (params are jit arguments:
+        hot-swap and routed dispatch reuse the trace).  None for duck
+        models with no jittable ``module`` (they keep their own
+        ``inference_batch``)."""
+        module = getattr(model, "module", None)
+        if module is None or not hasattr(module, "apply") \
+                or getattr(model, "params", None) is None:
+            return None  # RandomModel/stub ducks keep their own path
+        if self._fwd is not None:
+            prev = self._fwd_module
+            try:
+                if prev is module or prev == module:
+                    return self._fwd
+            except Exception:
+                pass
+        import jax
+
+        def apply(params, obs):
+            return module.apply({"params": params}, obs, None)
+
+        if self._mesh is not None:
+            from ..parallel.mesh import inference_shardings
+
+            self._infer_sh = inference_shardings(
+                self._mesh, model.params, fsdp=self._fsdp)
+            fwd = jax.jit(apply,
+                          in_shardings=(self._infer_sh.params,
+                                        self._infer_sh.obs),
+                          out_shardings=self._infer_sh.out)
+        else:
+            self._infer_sh = None
+            fwd = jax.jit(apply)
+        self._fwd = self.retrace_guard.wrap(self.shard_guard.wrap(fwd))
+        self._fwd_module = module
+        return self._fwd
+
+    def _placed_params(self, model):
+        """This snapshot's params on the inference param shardings —
+        ``device_put`` ONCE per snapshot (live or routed), cached on
+        the model object so the learner's LRU stores sharded pytrees
+        and no dispatch ever pays a per-request reshard.  The cache is
+        KEYED by the sharding set it was placed with: a snapshot that
+        crosses services with different meshes (tests, dry runs)
+        re-places instead of dispatching params committed to another
+        mesh's layout."""
+        if self._infer_sh is None:
+            return model.params
+        cached = getattr(model, "_infer_placed", None)
+        if cached is not None and cached[0] is self._infer_sh:
+            return cached[1]
+        import jax
+
+        placed = jax.device_put(model.params, self._infer_sh.params)
+        try:
+            model._infer_placed = (self._infer_sh, placed)
+        except Exception:
+            pass
+        return placed
+
+    def _forward(self, model, obs):
+        """One batched forward: numpy leaves in, numpy dict out (the
+        ``inference_batch`` contract), through the guarded jit."""
+        fwd = self._ensure_forward(model)
+        if fwd is None:
+            return model.inference_batch(obs, None)
+        import jax
+        import numpy as np
+
+        out = fwd(self._placed_params(model), obs)
+        return jax.tree.map(np.asarray, out)
 
     def _collect(self, pending, now):
         """One sweep over every request ring plus the network-plane
@@ -573,7 +709,13 @@ class InferenceService:
                     rows += items[i][2]
                     i += 1
                 t0 = telemetry.span_begin()
-                bucket = _bucket(rows, max(rows, self.cfg.max_batch))
+                cap = max(rows, self.cfg.max_batch)
+                if self._mesh is not None and rows > self.cfg.max_batch:
+                    # oversized chunk under a mesh: pad to the FULL
+                    # pow2 instead of clamping at the raw row count,
+                    # so the bucket keeps dividing the dp axis
+                    cap = 1 << (rows - 1).bit_length()
+                bucket = _bucket(rows, cap, self._bucket_floor)
                 leaves = [np.concatenate(parts, axis=0) for parts in zip(
                     *[leaves for _, _, _, leaves, _ in chunk])]
                 if bucket > rows:
@@ -582,7 +724,7 @@ class InferenceService:
                                         leaf.dtype)], axis=0)
                         for leaf in leaves]
                 obs = self._obs_tree(chunk[0][0], leaves)
-                outputs = model.inference_batch(obs, None)
+                outputs = self._forward(model, obs)
                 outputs.pop("hidden", None)
                 lo = 0
                 for client, seq, n, _leaves, _pin in chunk:
@@ -630,13 +772,15 @@ class InferenceService:
         try:
             if client is not None:
                 self._adopt_model()
-                buckets = {_bucket(1, self.cfg.max_batch),
-                           _bucket(client.rows_max, self.cfg.max_batch)}
+                buckets = {_bucket(1, self.cfg.max_batch,
+                                   self._bucket_floor),
+                           _bucket(client.rows_max, self.cfg.max_batch,
+                                   self._bucket_floor)}
                 for rows in sorted(buckets):
                     leaves = [np.zeros((rows,) + shape, dtype)
                               for shape, dtype in client.leaf_specs]
-                    self._model.inference_batch(
-                        self._obs_tree(client, leaves), None)
+                    self._forward(self._model,
+                                  self._obs_tree(client, leaves))
         finally:
             with self._lock:
                 if self._warm:

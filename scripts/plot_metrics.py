@@ -280,8 +280,12 @@ def plot(epochs, out_prefix):
     # burst, never a dip in their sum), upload_backlog is the deepest
     # worker-side hold backlog observed, and shm_torn_slots counts
     # slots reclaimed from producers that died mid-write (flat at 0
-    # outside churn).  All render through series(), so pre-PR-11
-    # metrics files still plot
+    # outside churn).  The GSPMD dispatch guard pair rides here too:
+    # infer_resharding_copies must stay flat at 0 (a climb = snapshots
+    # landing on the wrong layout, one silent copy per dispatch) and
+    # infer_compiles must plateau at the bucket-geometry count (a
+    # climb = snapshots recompiling the forward).  All render through
+    # series(), so pre-PR-11 metrics files still plot
     inf_cnt_keys = [k for k in ("infer_batch_size_mean",
                                 "infer_batch_size_p95",
                                 "infer_batches",
@@ -290,7 +294,9 @@ def plot(epochs, out_prefix):
                                 "episodes_shm",
                                 "episodes_spilled",
                                 "upload_backlog",
-                                "infer_respawns")
+                                "infer_respawns",
+                                "infer_resharding_copies",
+                                "infer_compiles")
                     if any(k in e for e in epochs)]
     inf_sec_keys = [k for k in ("infer_queue_wait_sec",)
                     if any(k in e for e in epochs)]

@@ -19,6 +19,27 @@ def train_step(mesh, params, batch):
     return step(params, batch)            # batch layout unknown: quiet
 
 
+class InferShardings:
+    def __init__(self, params, obs):
+        self.params = params
+        self.obs = obs
+
+
+def infer_shardings(mesh):
+    return InferShardings(params=NamedSharding(mesh, P()),
+                          obs=NamedSharding(mesh, P("dp")))
+
+
+def serve_step(mesh, params, obs):
+    # struct-builder fields resolve AND agree with the call site —
+    # the quiet twin of the pos fixture's serve_step
+    shards = infer_shardings(mesh)
+    fwd = jax.jit(lambda p, o: (p * o).sum(),
+                  in_shardings=(shards.params, shards.obs))
+    obs = jax.device_put(obs, shards.obs)  # matches in_shardings[1]
+    return fwd(params, obs)
+
+
 def trailing_none_equivalence(mesh, params, batch):
     # P() and P(None, None) are the same fully-replicated spec: jax
     # normalizes trailing Nones, so no copy happens and none is flagged

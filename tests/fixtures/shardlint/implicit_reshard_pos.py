@@ -18,3 +18,24 @@ def train_step(mesh, params, batch):
                    donate_argnums=(0,))
     params = jax.device_put(params, dp)  # but the jit expects P()
     return step(params, batch)
+
+
+class InferShardings:
+    def __init__(self, params, obs):
+        self.params = params
+        self.obs = obs
+
+
+def infer_shardings(mesh):
+    # the inference_shardings shape: a struct of per-role specs whose
+    # fields must resolve through the builder-return summary
+    return InferShardings(params=NamedSharding(mesh, P()),
+                          obs=NamedSharding(mesh, P("dp")))
+
+
+def serve_step(mesh, params, obs):
+    shards = infer_shardings(mesh)
+    fwd = jax.jit(lambda p, o: (p * o).sum(),
+                  in_shardings=(shards.params, shards.obs))
+    obs = jax.device_put(obs, shards.params)  # but the jit wants P('dp')
+    return fwd(params, obs)

@@ -18,3 +18,23 @@ def restore_step(mesh, params, batch):
     params = jax.device_put(params, dp)
     # jaxlint: disable=implicit-reshard -- one-time checkpoint restore; the copy is off the hot path
     return step(params, batch)
+
+
+class InferShardings:
+    def __init__(self, params, obs):
+        self.params = params
+        self.obs = obs
+
+
+def infer_shardings(mesh):
+    return InferShardings(params=NamedSharding(mesh, P()),
+                          obs=NamedSharding(mesh, P("dp")))
+
+
+def serve_restore(mesh, params, obs):
+    shards = infer_shardings(mesh)
+    fwd = jax.jit(lambda p, o: (p * o).sum(),
+                  in_shardings=(shards.params, shards.obs))
+    obs = jax.device_put(obs, shards.params)
+    # jaxlint: disable=implicit-reshard -- one-time snapshot placement at attach, off the dispatch hot path
+    return fwd(params, obs)

@@ -362,6 +362,61 @@ def test_fused_step_compiles_once_and_keeps_layouts():
     assert shard.copies == 0
 
 
+def test_fused_step_runs_tp_fsdp_mesh_with_sharded_pool():
+    """Mesh-general Anakin (GSPMD inference plane tentpole): the fused
+    step runs on a dp4 x tp2 + fsdp mesh — not just replicated-params
+    dp — with the opponent pool laid out EXACTLY like the params it
+    stacks (a replicated pool would keep K full weight copies per
+    device and defeat fsdp), the same 1-compile/0-reshard guard
+    contract, and a refresh that keeps the pool layout."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    from handyrl_tpu.analysis.guards import (
+        RetraceGuard,
+        ShardingContractGuard,
+    )
+    from handyrl_tpu.parallel import MeshSpec, make_mesh
+
+    mesh = make_mesh(MeshSpec(dp=4, tp=2), devices=jax.devices()[:8])
+    engine, params, optimizer = _engine(
+        num_envs=32, opponent_pool=1, mesh=mesh, fsdp=True)
+    params = jax.device_put(params, engine._p_shard)
+    opt_state = jax.jit(optimizer.init,
+                        out_shardings=engine._o_shard)(params)
+    pool = engine.init_pool(params)
+    # the fsdp rule reached the pool THROUGH its stack axis: some leaf
+    # shards a trailing dim over dp while the leading pool axis stays
+    # replicated
+    pool_specs = [tuple(l.sharding.spec) for l in jax.tree.leaves(pool)]
+    assert any("dp" in s for s in pool_specs), \
+        "pool leaves are replicated — the param layout never applied"
+    assert all(not s or s[0] is None for s in pool_specs), \
+        "the pool's stack axis must stay replicated"
+
+    retrace = RetraceGuard(max_compiles=1, name="anakin_mesh_step")
+    shard = ShardingContractGuard(max_copies=0, name="anakin_mesh_step")
+    step = retrace.wrap(shard.wrap(engine.make_fused_step()))
+    carry = engine.init_carry(0)
+    for _ in range(3):
+        params, opt_state, metrics, carry = step(
+            params, opt_state, carry, pool)
+    m = jax.device_get(metrics)
+    assert np.isfinite(float(m["total"]))
+    assert retrace.compiles == 1
+    assert shard.copies == 0
+    # params came back on their tp/fsdp layout (donation-compatible)
+    assert any("dp" in tuple(l.sharding.spec) or "tp" in
+               tuple(l.sharding.spec) for l in jax.tree.leaves(params))
+    # the epoch-boundary refresh keeps the pool layout, so the NEXT
+    # fused step sees the contract it compiled with
+    refreshed = engine.refresh_pool(pool, params)
+    assert [tuple(l.sharding.spec)
+            for l in jax.tree.leaves(refreshed)] == pool_specs
+    params, opt_state, metrics, carry = step(
+        params, opt_state, carry, refreshed)
+    assert retrace.compiles == 1 and shard.copies == 0
+
+
 def test_engine_layout_validation():
     env = make_env({"env": "TicTacToe"})
     env.reset()

@@ -102,6 +102,69 @@ def test_interprocedural_mesh_axes_cross_module():
         assert "'sp'" in findings[0].message
 
 
+def test_struct_builder_fields_resolve_interprocedurally():
+    """The inference_shardings shape: a builder returning a STRUCT of
+    shardings must summarize per-field, so `shards.obs` at a jit
+    contract (and at a device_put call site) resolves through the
+    builder — the pos fixture's serve_step finding is the proof the
+    new machinery fires, not a ride-along of the old single-spec
+    case."""
+    findings = lint_paths([fixture("implicit-reshard", "pos")],
+                          shard=True)
+    assert len(findings) == 2, [(f.rule, f.line) for f in findings]
+    with open(fixture("implicit-reshard", "pos")) as f:
+        lines = f.read().splitlines()
+    struct_hits = [f for f in findings
+                   if "fwd(params, obs)" in lines[f.line - 1]]
+    assert len(struct_hits) == 1, [(f.rule, f.line) for f in findings]
+    assert "PartitionSpec('dp',)" in struct_hits[0].message
+
+
+def test_struct_subscript_and_dict_literal_resolve():
+    """String subscripts on a dict-literal spec bundle resolve the
+    same way attribute access on a constructor does."""
+    src = (
+        "import jax\n"
+        "import numpy as np\n"
+        "from jax.sharding import Mesh, NamedSharding, "
+        "PartitionSpec as P\n\n\n"
+        "def make_mesh():\n"
+        "    return Mesh(np.asarray(jax.devices()).reshape(-1, 1), "
+        "('dp', 'tp'))\n\n\n"
+        "def shardings(mesh):\n"
+        "    return {'obs': NamedSharding(mesh, P('dp')),\n"
+        "            'rep': NamedSharding(mesh, P())}\n\n\n"
+        "def serve(mesh, obs):\n"
+        "    sh = shardings(mesh)\n"
+        "    fwd = jax.jit(lambda o: o.sum(), "
+        "in_shardings=(sh['obs'],))\n"
+        "    obs = jax.device_put(obs, sh['rep'])\n"
+        "    return fwd(obs)\n")
+    findings = lint_source(src, shard=True)
+    assert [f.rule for f in findings] == ["implicit-reshard"]
+
+
+def test_repo_inference_shardings_summary_is_discovered():
+    """The analyzer must actually summarize the repo's
+    inference_shardings builder (obs/out exact on dp) — a refactor
+    that hides the struct would silently disable the resolution the
+    fixtures prove."""
+    from handyrl_tpu.analysis.jaxlint import load_package
+    from handyrl_tpu.analysis.shardlint import analyze
+
+    package, _, _ = load_package([REPO_PACKAGE])
+    an = analyze(package)
+    summaries = {fn.qname: fields
+                 for fn, fields in an.struct_returns.items()}
+    match = [fields for qname, fields in summaries.items()
+             if qname.endswith("inference_shardings")]
+    assert match, f"no struct summary for inference_shardings: " \
+                  f"{sorted(summaries)}"
+    fields = match[0]
+    assert fields["obs"].exact and fields["obs"].sig == ("dp",)
+    assert fields["out"].exact and fields["out"].sig == ("dp",)
+
+
 def test_divergent_control_sees_attribute_facts():
     """self.primary = jax.process_index() == 0 in __init__ makes a
     later `if self.primary:` divergent — the learner's exact shape."""

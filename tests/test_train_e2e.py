@@ -120,6 +120,19 @@ def test_local_training_two_epochs(tmp_path, monkeypatch):
         assert record["queue_depth"] >= 0
         assert record["epoch_wall_sec"] > 0.0
         assert record["time_sec"] >= record["epoch_wall_sec"]
+        # the inference dispatch carries the SAME guard contract as
+        # the update step (GSPMD inference plane): zero resharding
+        # copies every epoch, and the compile count never exceeds the
+        # batch-bucket geometries — snapshots hot-swap through one
+        # compiled forward, they never add a compile
+        assert record["infer_resharding_copies"] == 0
+        # exactly one compile per batch-bucket geometry — snapshots
+        # hot-swap every epoch through ONE compiled forward, so the
+        # cumulative count is bounded by the handful of pow2 buckets
+        # this tiny fleet can produce, never by the epoch count.
+        # (The multichip dry-run script pins the per-geometry count
+        # exactly on a deterministic synchronous dispatch.)
+        assert 0 <= record["infer_compiles"] <= 4
 
     # the run's span logs export to a Perfetto trace whose propagated
     # ids cross at least two processes (worker rollouts -> learner
