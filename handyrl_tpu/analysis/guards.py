@@ -35,6 +35,14 @@ knows:
     runtime complement of commlint's ``unbounded-recv``/
     ``reply-mismatch`` rules, catching the wedges the analyzer could
     not prove (or that a suppression claimed were bounded).
+  * :class:`LockOrderGuard` wraps the package's lock objects in
+    timing/ordering proxies: per-epoch ``lock_contention_sec`` (wall
+    time threads spent waiting on guarded locks) and
+    ``lock_order_inversions`` (two locks observed acquired in both
+    orders at runtime) — the runtime complement of racelint's
+    ``lock-order-cycle``/``blocking-under-lock`` rules, catching the
+    interleavings the analyzer could not reach (locks passed through
+    config, dynamic handler sets).
 
 All are near-zero-cost (an isinstance check / an integer bump per
 event) and run armed in production: the learner feeds their per-epoch
@@ -532,3 +540,164 @@ class HostTransferGuard:
             np.asarray = saved["asarray"]
             np.array = saved["array"]
         return False
+
+
+class _GuardedLock:
+    """Proxy around one lock that reports waits and ordering to its
+    :class:`LockOrderGuard`.  Drop-in for ``threading.Lock`` /
+    ``RLock``: ``with``, ``acquire``/``release``, and anything else
+    forwards to the wrapped lock."""
+
+    def __init__(self, guard: "LockOrderGuard", inner, name: str):
+        self._guard = guard
+        self._inner = inner
+        self._name = name
+
+    def acquire(self, blocking=True, timeout=-1):
+        clock = self._guard.clock
+        t0 = clock()
+        got = self._inner.acquire(blocking, timeout)
+        waited = max(0.0, clock() - t0)
+        if got:
+            self._guard._note_acquired(self._name, waited)
+        elif waited:
+            self._guard._note_wait(waited)
+        return got
+
+    def release(self):
+        self._inner.release()
+        self._guard._note_released(self._name)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __getattr__(self, attr):
+        return getattr(self._inner, attr)
+
+
+class LockOrderGuard:
+    """Runtime lock-order/contention accounting for the control plane.
+
+    Racelint's ``lock-order-cycle`` proves what it can from source;
+    this guard watches the locks that actually run.  :meth:`wrap`
+    replaces a lock with a :class:`_GuardedLock` proxy (and
+    :meth:`arm` does so in place on an object attribute); every
+    acquire then
+
+      * accumulates the wall time the acquiring thread waited
+        (``lock_contention_sec`` — uncontended acquires cost
+        microseconds and contribute ~0);
+      * records the per-thread held-set and, for each (held, new)
+        pair, the first-seen acquisition direction; observing the
+        *reverse* direction later is a counted
+        ``lock_order_inversion`` — a latent ABBA deadlock that simply
+        has not fired yet.
+
+    Reentrant re-acquire of a lock already held by the thread records
+    no pair (RLocks do that by design).  ``clock`` is injectable for
+    tests.  :meth:`snapshot` returns per-epoch deltas for the metrics
+    jsonl; :meth:`stats` the cumulative totals for the status
+    endpoint.  Near-zero cost: two clock reads and a couple of dict
+    ops per acquire, on locks that guard microsecond critical
+    sections.
+    """
+
+    def __init__(self, clock=time.monotonic):
+        self.clock = clock
+        self.contention_sec = 0.0
+        self.inversions = 0
+        self._last_contention = 0.0
+        self._last_inversions = 0
+        self._names = []                  # wrap() order, for stats()
+        self._pairs = {}                  # frozenset({a,b}) -> (a, b)
+        self._meta = threading.Lock()     # guards the counters above
+        self._held = threading.local()    # per-thread stack of names
+
+    # -- wrapping -----------------------------------------------------
+    def wrap(self, lock, name: str):
+        """Wrap ``lock`` in a reporting proxy registered as ``name``."""
+        if isinstance(lock, _GuardedLock):
+            return lock
+        with self._meta:
+            if name not in self._names:
+                self._names.append(name)
+        return _GuardedLock(self, lock, name)
+
+    def arm(self, obj, attr: str = "_lock", name=None) -> bool:
+        """Replace ``obj.attr`` with its wrapped proxy in place.
+        Returns False (and does nothing) when the object is None, the
+        attribute is missing, or it is already wrapped — so the
+        learner can arm every subsystem it *might* have without
+        caring which are enabled this run."""
+        if obj is None or not hasattr(obj, attr):
+            return False
+        lock = getattr(obj, attr)
+        if lock is None or isinstance(lock, _GuardedLock):
+            return False
+        if name is None:
+            name = f"{type(obj).__name__}.{attr}"
+        setattr(obj, attr, self.wrap(lock, name))
+        return True
+
+    # -- proxy callbacks ----------------------------------------------
+    def _stack(self):
+        stack = getattr(self._held, "stack", None)
+        if stack is None:
+            stack = self._held.stack = []
+        return stack
+
+    def _note_acquired(self, name: str, waited: float):
+        stack = self._stack()
+        reentrant = name in stack
+        if not reentrant and stack:
+            with self._meta:
+                self.contention_sec += waited
+                for held in stack:
+                    pair = frozenset((held, name))
+                    first = self._pairs.get(pair)
+                    if first is None:
+                        self._pairs[pair] = (held, name)
+                    elif first != (held, name):
+                        self.inversions += 1
+        elif waited:
+            self._note_wait(waited)
+        stack.append(name)
+
+    def _note_released(self, name: str):
+        stack = self._stack()
+        # pop the most recent occurrence: releases may be unnested
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == name:
+                del stack[i]
+                break
+
+    def _note_wait(self, waited: float):
+        with self._meta:
+            self.contention_sec += waited
+
+    # -- reporting ----------------------------------------------------
+    def snapshot(self) -> dict:
+        """Per-epoch deltas since the previous snapshot, keyed exactly
+        as the metrics jsonl expects."""
+        with self._meta:
+            contention = self.contention_sec - self._last_contention
+            inversions = self.inversions - self._last_inversions
+            self._last_contention = self.contention_sec
+            self._last_inversions = self.inversions
+        return {"lock_contention_sec": round(contention, 6),
+                "lock_order_inversions": inversions}
+
+    def stats(self) -> dict:
+        """Cumulative totals for the status endpoint."""
+        with self._meta:
+            return {"locks_guarded": len(self._names),
+                    "lock_contention_sec": round(self.contention_sec, 6),
+                    "lock_order_inversions": self.inversions}

@@ -10,6 +10,7 @@ Usage::
     python -m handyrl_tpu.analysis.jaxlint --json handyrl_tpu/
     python -m handyrl_tpu.analysis.jaxlint --shard handyrl_tpu/
     python -m handyrl_tpu.analysis.jaxlint --comm handyrl_tpu/
+    python -m handyrl_tpu.analysis.jaxlint --race handyrl_tpu/
     python -m handyrl_tpu.analysis.jaxlint --sarif handyrl_tpu/
     python -m handyrl_tpu.analysis.jaxlint --list-rules
     handyrl-jaxlint handyrl_tpu/            # console-script entry
@@ -18,10 +19,14 @@ Usage::
 set (:mod:`.shardrules` — mesh-axis validity, implicit resharding,
 multihost divergence) and ``--comm`` the control-plane protocol/
 concurrency rule set (:mod:`.commrules` — unhandled/dead verbs, reply
-wedges, unbounded recvs, unpicklable payloads, fork safety); the flags
-compose.  ``--sarif`` emits SARIF 2.1.0 for GitHub code scanning;
-``--exclude`` drops path prefixes (e.g. test fixtures) from directory
-scans.  ``--list-rules`` always prints all three rule families.
+wedges, unbounded recvs, unpicklable payloads, fork safety) and
+``--race`` the thread-safety rule set (:mod:`.racerules` — unguarded
+shared writes, non-atomic read-modify-writes, live-container
+iteration, lock-order cycles, blocking under a lock, leaked
+acquires); the flags compose.  ``--sarif`` emits SARIF 2.1.0 for
+GitHub code scanning; ``--exclude`` drops path prefixes (e.g. test
+fixtures) from directory scans.  ``--list-rules`` always prints all
+four rule families.
 
 Exit status: 0 when clean, 1 when any finding survives suppression,
 2 on usage/IO errors.
@@ -206,10 +211,12 @@ def load_package(paths: List[str], exclude: Optional[List[str]] = None):
 
 
 def active_registry(shard: bool = False,
-                    comm: bool = False) -> Dict[str, "object"]:
+                    comm: bool = False,
+                    race: bool = False) -> Dict[str, "object"]:
     """The rule registry in force: jaxlint's base rules, plus the
-    shardlint rules with ``shard=True`` and the commlint rules with
-    ``comm=True`` (the flags compose)."""
+    shardlint rules with ``shard=True``, the commlint rules with
+    ``comm=True``, and the racelint rules with ``race=True`` (the
+    flags compose)."""
     registry = dict(RULES)
     if shard:
         from .shardrules import SHARD_RULES
@@ -219,6 +226,10 @@ def active_registry(shard: bool = False,
         from .commrules import COMM_RULES
 
         registry.update(COMM_RULES)
+    if race:
+        from .racerules import RACE_RULES
+
+        registry.update(RACE_RULES)
     return registry
 
 
@@ -226,6 +237,7 @@ def lint_paths(paths: List[str],
                select: Optional[List[str]] = None,
                shard: bool = False,
                comm: bool = False,
+               race: bool = False,
                exclude: Optional[List[str]] = None) -> List[Finding]:
     """Run the (selected) rules over ``paths``; returns surviving
     findings sorted by location."""
@@ -236,7 +248,7 @@ def lint_paths(paths: List[str],
     ]
     compute_tracer_taint(package)
     compute_device_summaries(package)
-    registry = active_registry(shard, comm)
+    registry = active_registry(shard, comm, race)
     active = [registry[r] for r in (select or sorted(registry))]
     for mod in package.modules.values():
         supp = suppressions[mod.path]
@@ -258,13 +270,14 @@ def lint_paths(paths: List[str],
 def lint_source(source: str, name: str = "<string>",
                 select: Optional[List[str]] = None,
                 shard: bool = False,
-                comm: bool = False) -> List[Finding]:
+                comm: bool = False,
+                race: bool = False) -> List[Finding]:
     """Lint one in-memory module (test/fixture helper)."""
     module = ModuleInfo(name, name, source)
     package = Package([module])
     compute_tracer_taint(package)
     compute_device_summaries(package)
-    registry = active_registry(shard, comm)
+    registry = active_registry(shard, comm, race)
     supp = Suppressions(source, name)
     findings: List[Finding] = []
     if supp.skip_file:
@@ -380,6 +393,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--comm", action="store_true",
                         help="also run the control-plane protocol/"
                              "concurrency rules (commlint)")
+    parser.add_argument("--race", action="store_true",
+                        help="also run the thread-safety/lock-order "
+                             "rules (racelint)")
     parser.add_argument("--select", default=None,
                         help="comma-separated rule ids to run "
                              "(default: all)")
@@ -391,11 +407,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="print the rule registry and exit")
     args = parser.parse_args(argv)
 
-    registry = active_registry(args.shard, args.comm)
+    registry = active_registry(args.shard, args.comm, args.race)
     if args.list_rules:
         # the rule LISTING is documentation, not a gate: always show
-        # every registered family (jax + shard + comm) with its doc
-        _print_rules(active_registry(shard=True, comm=True))
+        # every registered family (jax + shard + comm + race) with
+        # its doc
+        _print_rules(active_registry(shard=True, comm=True, race=True))
         return 0
     if args.json and args.sarif:
         print("jaxlint: --json and --sarif are mutually exclusive",
@@ -414,7 +431,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     paths = args.paths or ["handyrl_tpu"]
     try:
         findings = lint_paths(paths, select=select, shard=args.shard,
-                              comm=args.comm, exclude=args.exclude)
+                              comm=args.comm, race=args.race,
+                              exclude=args.exclude)
     except FileNotFoundError as exc:
         print(f"jaxlint: no such path: {exc}", file=sys.stderr)
         return 2

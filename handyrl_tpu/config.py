@@ -201,6 +201,12 @@ class TrainConfig:
     # boundary beats through trainer.update(), so ordinary long epochs
     # do not count)
     max_stall_seconds: float = 60.0
+    # arm a LockOrderGuard over the control plane's lock objects
+    # (communicator, fleet registry, inference service, serving
+    # frontend, supervisor, watchdog): per-epoch `lock_contention_sec`
+    # and `lock_order_inversions` in the metrics jsonl — the runtime
+    # twin of racelint's lock-order-cycle rule
+    lock_order_guard: bool = True
     # -- telemetry (handyrl_tpu.telemetry) --
     # arm span tracing + the flight recorder: trace_span sections,
     # trace-context propagation over the control plane, per-process

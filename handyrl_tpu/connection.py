@@ -369,18 +369,26 @@ class QueueCommunicator:
         version-skewed worker fleet can send thousands of these, and
         the first line says everything the next ones would."""
         verb = str(verb)
-        count = self.unknown_verbs.get(verb, 0)
-        self.unknown_verbs[verb] = count + 1
+        with self._lock:
+            count = self.unknown_verbs.get(verb, 0)
+            self.unknown_verbs[verb] = count + 1
         if count == 0:
             print(f"WARNING: unknown control-plane verb {verb!r} "
                   f"(version skew or a stray client?); replying empty "
                   f"— further occurrences counted silently")
 
     def drop_stats(self) -> Dict[str, int]:
-        """Drop counters for the learner's FleetRegistry / metrics."""
-        return {"send_drops": self.send_drops,
-                "disconnects": self.disconnects,
-                "unknown_verbs": sum(self.unknown_verbs.values())}
+        """Drop counters for the learner's FleetRegistry / metrics.
+
+        Snapshot taken under the counters' lock: the status HTTP
+        thread calls this while the send/recv loops are bumping the
+        counters, and a bare read could pair a pre-update
+        ``send_drops`` with a post-update ``disconnects`` (or iterate
+        ``unknown_verbs`` mid-insert)."""
+        with self._lock:
+            return {"send_drops": self.send_drops,
+                    "disconnects": self.disconnects,
+                    "unknown_verbs": sum(self.unknown_verbs.values())}
 
     def fleet_stats(self) -> Dict[str, int]:
         """Fleet-health contribution for the per-epoch metrics record;
@@ -407,16 +415,18 @@ class QueueCommunicator:
                 continue
             with self._lock:
                 live = conn in self.conns
+                if not live:
+                    # the peer died between enqueue and write: drop
+                    # and count instead of feeding the daemon thread
+                    # an exception on a closed handle
+                    self.send_drops += 1
             if not live:
-                # the peer died between enqueue and write: drop and
-                # count instead of feeding the daemon thread an
-                # exception on a closed handle
-                self.send_drops += 1
                 continue
             try:
                 conn.send(send_data)
             except (ConnectionResetError, BrokenPipeError, OSError):
-                self.send_drops += 1
+                with self._lock:
+                    self.send_drops += 1
                 self.disconnect(conn)
 
     def add_connection(self, conn):
@@ -424,10 +434,13 @@ class QueueCommunicator:
             self.conns[conn] = True
 
     def disconnect(self, conn):
+        # the counter bump shares the pop's critical section: both the
+        # send loop and the recv loop disconnect dead peers, and two
+        # unlocked += on the same counter can lose one
         with self._lock:
             removed = self.conns.pop(conn, None) is not None
-        if removed:
-            self.disconnects += 1
+            if removed:
+                self.disconnects += 1
         try:
             conn.close()
         except OSError:

@@ -45,6 +45,7 @@ except ImportError:  # pragma: no cover
 from . import telemetry
 from .analysis.guards import (
     HostTransferGuard,
+    LockOrderGuard,
     RetraceGuard,
     ShardingContractGuard,
     StallWatchdog,
@@ -1705,6 +1706,25 @@ class Learner:
             # timeline of the 30s before the wedge
             self.stall_watchdog.on_stall = telemetry.stall_hook
             self.stall_watchdog.start()
+        # lock-order/contention guard: wraps every control-plane lock
+        # in a timing proxy; per-epoch lock_contention_sec and
+        # lock_order_inversions land in metrics.jsonl next to
+        # stall_events (the runtime twin of racelint's
+        # lock-order-cycle rule).  arm() is tolerant of absent
+        # subsystems, so one list covers every configuration
+        self.lock_guard = None
+        if self.args.get("lock_order_guard", True):
+            self.lock_guard = LockOrderGuard()
+            for obj, attr in (
+                    (self.worker, "_lock"),
+                    (self.worker, "_admit_lock"),
+                    (getattr(self.worker, "supervisor", None), "_lock"),
+                    (self.fleet, "_lock"),
+                    (self.infer_service, "_lock"),
+                    (self.serve_frontend, "_lock"),
+                    (self.stall_watchdog, "_lock"),
+            ):
+                self.lock_guard.arm(obj, attr)
         # read-only live status endpoint (dashboards poll this instead
         # of touching the control plane); 0 = off
         self.status = None
@@ -1729,6 +1749,9 @@ class Learner:
             "telemetry": telemetry.stats(),
             "last_record": self._last_record,
         }
+        lock_guard = getattr(self, "lock_guard", None)
+        if lock_guard is not None:
+            snap["locks"] = lock_guard.stats()
         if self.wal is not None:
             snap["wal"] = self.wal.stats()
         trainer = getattr(self, "trainer", None)
@@ -2201,6 +2224,11 @@ class Learner:
             # writer threads silent past max_stall_seconds); steady
             # state is 0 — see analysis.guards.StallWatchdog
             record["stall_events"] = self.stall_watchdog.snapshot()
+        if self.lock_guard is not None:
+            # seconds threads spent waiting on control-plane locks +
+            # runtime ABBA order inversions this epoch; steady state
+            # is (~0, 0) — see analysis.guards.LockOrderGuard
+            record.update(self.lock_guard.snapshot())
         if self.metrics_path and self.primary:
             with open(self.metrics_path, "a") as f:
                 f.write(json.dumps(record) + "\n")

@@ -476,7 +476,10 @@ class InferenceService:
         again.  Crashing here would take the learner's server loop
         (and every client) down over one bad frame."""
         if ring.skip_one():
-            self.corrupt += 1
+            # bumped from both the learner's drain thread and the
+            # service loop — unlocked += on both would lose counts
+            with self._lock:
+                self.corrupt += 1
             print(f"WARNING: corrupt {kind} slot from client {cid} "
                   f"skipped ({exc!r})")
 
@@ -492,7 +495,9 @@ class InferenceService:
             return now
         if now - stuck_since >= self.TORN_GRACE:
             if ring.skip_torn():
-                self.reclaimed += 1
+                # same two-thread caller set as _skip_corrupt above
+                with self._lock:
+                    self.reclaimed += 1
                 print(f"WARNING: torn {kind} slot from client {cid} "
                       f"reclaimed (writer dead mid-RESERVE-THEN-FILL, "
                       f"stalled {now - stuck_since:.0f}s); the ring "

@@ -157,7 +157,9 @@ def plot(epochs, out_prefix):
     # host_transfers is the per-epoch delta and must not grow with the
     # step count — a rising line on either is a hot-path regression
     guard_keys = [k for k in ("retrace_count", "host_transfers",
-                              "resharding_copies", "stall_events")
+                              "resharding_copies", "stall_events",
+                              "lock_contention_sec",
+                              "lock_order_inversions")
                   if any(k in e for e in epochs)]
     if guard_keys:
         fig, ax = plt.subplots(figsize=(8, 5))

@@ -1,0 +1,22 @@
+"""Negative: the slow calls run outside the lock; the lock covers
+only the state update."""
+
+import threading
+import time
+
+
+class Gate:
+    def __init__(self, conn):
+        self._lock = threading.Lock()
+        self.conn = conn
+        self.frames = 0
+
+    def nap(self):
+        time.sleep(1.0)
+        with self._lock:
+            self.frames = self.frames + 1
+
+    def pull(self):
+        data = self.conn.recv()
+        with self._lock:
+            self.frames = self.frames + len(data)

@@ -1,0 +1,21 @@
+"""Positive: the critical section parks the thread — every other
+thread needing the lock stalls behind it."""
+
+import threading
+import time
+
+
+class Gate:
+    def __init__(self, conn):
+        self._lock = threading.Lock()
+        self.conn = conn
+        self.frames = 0
+
+    def nap(self):
+        with self._lock:
+            time.sleep(1.0)
+
+    def pull(self):
+        with self._lock:
+            data = self.conn.recv()
+            self.frames = self.frames + len(data)

@@ -1,0 +1,22 @@
+"""Suppressed: the blocking call is bounded and says why."""
+
+import threading
+import time
+
+
+class Gate:
+    def __init__(self, conn):
+        self._lock = threading.Lock()
+        self.conn = conn
+        self.frames = 0
+
+    def nap(self):
+        with self._lock:
+            # jaxlint: disable=blocking-under-lock -- 10ms settle delay bounded by the hardware spec; no other thread exists during calibration
+            time.sleep(0.01)
+
+    def pull(self):
+        with self._lock:
+            # jaxlint: disable=blocking-under-lock -- socket has a 50ms timeout; the lock is per-connection and uncontended
+            data = self.conn.recv()
+            self.frames = self.frames + len(data)

@@ -1,0 +1,14 @@
+"""Negative: the release lives in a finally block, so any exception
+still releases."""
+
+import threading
+
+GATE = threading.Lock()
+
+
+def grab(work):
+    GATE.acquire()
+    try:
+        return work()
+    finally:
+        GATE.release()

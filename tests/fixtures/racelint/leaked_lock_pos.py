@@ -1,0 +1,13 @@
+"""Positive: a bare acquire whose release is not finally-protected —
+the first exception in between leaks the lock forever."""
+
+import threading
+
+GATE = threading.Lock()
+
+
+def grab(work):
+    GATE.acquire()
+    result = work()
+    GATE.release()
+    return result

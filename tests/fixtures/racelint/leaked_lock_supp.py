@@ -1,0 +1,13 @@
+"""Suppressed: a hand-rolled acquire/release pair with the reason."""
+
+import threading
+
+GATE = threading.Lock()
+
+
+def grab(work):
+    # jaxlint: disable=leaked-lock -- work() is a pre-validated pure callable that cannot raise; release follows unconditionally
+    GATE.acquire()
+    result = work()
+    GATE.release()
+    return result

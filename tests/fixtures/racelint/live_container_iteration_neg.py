@@ -1,0 +1,23 @@
+"""Negative: the iteration happens on a snapshot taken under the same
+lock the mutator holds."""
+
+import threading
+
+
+class Board:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.scores = {}
+
+    def start(self):
+        threading.Thread(target=self._ingest, daemon=True).start()
+
+    def _ingest(self):
+        while True:
+            with self._lock:
+                self.scores["game"] = 1
+
+    def totals(self):
+        with self._lock:
+            snapshot = list(self.scores.values())
+        return sum(snapshot)

@@ -1,0 +1,22 @@
+"""Positive: the status path iterates self.scores live while the
+ingest thread mutates it — dictionary-changed-size-during-iteration
+waiting to happen."""
+
+import threading
+
+
+class Board:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.scores = {}
+
+    def start(self):
+        threading.Thread(target=self._ingest, daemon=True).start()
+
+    def _ingest(self):
+        while True:
+            with self._lock:
+                self.scores["game"] = 1
+
+    def totals(self):
+        return sum(self.scores.values())  # live view, no lock

@@ -1,0 +1,21 @@
+"""Suppressed: the live iteration is tolerated and says why."""
+
+import threading
+
+
+class Board:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.scores = {}
+
+    def start(self):
+        threading.Thread(target=self._ingest, daemon=True).start()
+
+    def _ingest(self):
+        while True:
+            with self._lock:
+                self.scores["game"] = 1
+
+    def totals(self):
+        # jaxlint: disable=live-container-iteration -- keys are fixed after startup; values are atomic int rebinds
+        return sum(self.scores.values())

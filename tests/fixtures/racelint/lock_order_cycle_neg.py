@@ -1,0 +1,21 @@
+"""Negative: both paths take the locks in one global order."""
+
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.x = 0
+        self.y = 0
+
+    def fwd(self):
+        with self._a:
+            with self._b:
+                self.x = self.y
+
+    def rev(self):
+        with self._a:
+            with self._b:
+                self.y = self.x

@@ -1,0 +1,22 @@
+"""Positive: fwd takes A then B, rev takes B then A — two threads
+meeting in the middle deadlock."""
+
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.x = 0
+        self.y = 0
+
+    def fwd(self):
+        with self._a:
+            with self._b:
+                self.x = self.y
+
+    def rev(self):
+        with self._b:
+            with self._a:
+                self.y = self.x

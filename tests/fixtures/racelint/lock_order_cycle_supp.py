@@ -1,0 +1,23 @@
+"""Suppressed: both opposite-order acquisitions carry the reason."""
+
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.x = 0
+        self.y = 0
+
+    def fwd(self):
+        with self._a:
+            # jaxlint: disable=lock-order-cycle -- fwd/rev are phase-exclusive: rev only runs after fwd's thread has exited
+            with self._b:
+                self.x = self.y
+
+    def rev(self):
+        with self._b:
+            # jaxlint: disable=lock-order-cycle -- fwd/rev are phase-exclusive: rev only runs after fwd's thread has exited
+            with self._a:
+                self.y = self.x

@@ -1,0 +1,26 @@
+"""Negative: the cross-thread read-modify-write holds the lock, so
+increments serialize."""
+
+import threading
+
+
+class Meter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.inflight = 0
+
+    def start(self):
+        threading.Thread(target=self._drain, daemon=True).start()
+        threading.Thread(target=self._pump, daemon=True).start()
+
+    def _drain(self):
+        while True:
+            self._bump()
+
+    def _pump(self):
+        while True:
+            self._bump()
+
+    def _bump(self):
+        with self._lock:
+            self.inflight += 1

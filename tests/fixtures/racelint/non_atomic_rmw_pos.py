@@ -1,0 +1,25 @@
+"""Positive: two thread roots both run _bump, whose unlocked += can
+interleave LOAD/ADD/STORE and lose an increment — the inflight-cap
+bug class."""
+
+import threading
+
+
+class Meter:
+    def __init__(self):
+        self.inflight = 0
+
+    def start(self):
+        threading.Thread(target=self._drain, daemon=True).start()
+        threading.Thread(target=self._pump, daemon=True).start()
+
+    def _drain(self):
+        while True:
+            self._bump()
+
+    def _pump(self):
+        while True:
+            self._bump()
+
+    def _bump(self):
+        self.inflight += 1  # runs on both threads, no lock

@@ -1,0 +1,24 @@
+"""Suppressed: a deliberately approximate counter, with the reason."""
+
+import threading
+
+
+class Meter:
+    def __init__(self):
+        self.inflight = 0
+
+    def start(self):
+        threading.Thread(target=self._drain, daemon=True).start()
+        threading.Thread(target=self._pump, daemon=True).start()
+
+    def _drain(self):
+        while True:
+            self._bump()
+
+    def _pump(self):
+        while True:
+            self._bump()
+
+    def _bump(self):
+        # jaxlint: disable=non-atomic-rmw -- advisory load-shedding estimate; a lost increment only delays shedding by one request
+        self.inflight += 1

@@ -1,0 +1,22 @@
+"""Negative: every access of self.jobs — including the main-thread
+reset — holds the same lock."""
+
+import threading
+
+
+class Tracker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.jobs = {}
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                self.jobs["tick"] = len(self.jobs)
+
+    def reset(self):
+        with self._lock:
+            self.jobs = {}

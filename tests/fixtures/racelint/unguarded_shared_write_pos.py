@@ -1,0 +1,22 @@
+"""Positive: the worker thread guards self.jobs with self._lock, but
+reset() (main thread) replaces the dict bare — the guarded readers
+still race with it."""
+
+import threading
+
+
+class Tracker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.jobs = {}
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                self.jobs["tick"] = len(self.jobs)
+
+    def reset(self):
+        self.jobs = {}  # bare write; every other access holds _lock

@@ -1,0 +1,21 @@
+"""Suppressed: the bare write is intentional and says why."""
+
+import threading
+
+
+class Tracker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.jobs = {}
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                self.jobs["tick"] = len(self.jobs)
+
+    def reset(self):
+        # jaxlint: disable=unguarded-shared-write -- rebind is atomic under the GIL and the loop tolerates either dict
+        self.jobs = {}

@@ -367,3 +367,115 @@ def test_stall_watchdog_start_stop_idempotent():
     dog.stop()
     dog.stop()                        # second stop is a no-op
     assert dog.stall_events == 0
+
+
+# ---------------------------------------------------------------------
+# LockOrderGuard
+# ---------------------------------------------------------------------
+
+def test_lock_guard_counts_contention_with_injected_clock():
+    import threading
+
+    from handyrl_tpu.analysis.guards import LockOrderGuard
+
+    # each acquire reads the clock twice (before/after): 1.5s of
+    # "wait" on the first acquire, none on the rest
+    times = iter([0.0, 1.5, 2.0, 2.0, 3.0, 3.0])
+    guard = LockOrderGuard(clock=lambda: next(times))
+    lock = guard.wrap(threading.Lock(), "A")
+    with lock:
+        pass
+    with lock:
+        pass
+    with lock:
+        pass
+    snap = guard.snapshot()
+    assert snap["lock_contention_sec"] == pytest.approx(1.5)
+    assert snap["lock_order_inversions"] == 0
+
+
+def test_lock_guard_detects_forced_order_inversion():
+    """A then B fixes the direction; B then A later is a counted
+    inversion — the latent ABBA deadlock that has not fired yet."""
+    import threading
+
+    from handyrl_tpu.analysis.guards import LockOrderGuard
+
+    t = [0.0]
+    guard = LockOrderGuard(clock=lambda: t[0])
+    a = guard.wrap(threading.Lock(), "A")
+    b = guard.wrap(threading.Lock(), "B")
+    with a:
+        with b:
+            pass
+    assert guard.inversions == 0
+    with b:
+        with a:
+            pass
+    assert guard.inversions == 1
+    snap = guard.snapshot()
+    assert snap["lock_order_inversions"] == 1
+    assert guard.snapshot()["lock_order_inversions"] == 0  # delta
+
+
+def test_lock_guard_reentrant_reacquire_records_no_pair():
+    import threading
+
+    from handyrl_tpu.analysis.guards import LockOrderGuard
+
+    t = [0.0]
+    guard = LockOrderGuard(clock=lambda: t[0])
+    r = guard.wrap(threading.RLock(), "R")
+    with r:
+        with r:
+            pass
+    assert guard.inversions == 0
+    assert guard.stats()["locks_guarded"] == 1
+
+
+def test_lock_guard_arm_replaces_in_place_and_tolerates_absence():
+    import threading
+
+    from handyrl_tpu.analysis.guards import LockOrderGuard, _GuardedLock
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+    guard = LockOrderGuard()
+    box = Box()
+    assert guard.arm(box, "_lock")
+    assert isinstance(box._lock, _GuardedLock)
+    assert not guard.arm(box, "_lock")       # already wrapped
+    assert not guard.arm(box, "_missing")    # absent attribute
+    assert not guard.arm(None, "_lock")      # absent subsystem
+    with box._lock:                          # still a working lock
+        assert box._lock.locked()
+    assert not box._lock.locked()
+
+
+def test_lock_guard_cross_thread_contention_real_clock():
+    """Two real threads contending on one guarded lock: the waiter's
+    blocked time lands in lock_contention_sec."""
+    import threading
+    import time as _time
+
+    from handyrl_tpu.analysis.guards import LockOrderGuard
+
+    guard = LockOrderGuard()
+    lock = guard.wrap(threading.Lock(), "hot")
+    entered = threading.Event()
+
+    def holder():
+        with lock:
+            entered.set()
+            _time.sleep(0.2)
+
+    thread = threading.Thread(target=holder)
+    thread.start()
+    entered.wait(5)
+    with lock:
+        pass
+    thread.join(5)
+    assert guard.stats()["lock_contention_sec"] >= 0.1
+    assert guard.stats()["lock_order_inversions"] == 0

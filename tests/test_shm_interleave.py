@@ -1,0 +1,241 @@
+"""Exhaustive seqlock interleaving suite for pipeline/shm.py — the
+dynamic twin of racelint's static rules.
+
+The SPSC ring's RESERVE-THEN-FILL protocol claims: whatever points the
+producer and consumer interleave at — including the producer dying at
+any point mid-write — a reader either decodes a COMPLETE payload or
+sees nothing, never a torn one undetected.  This suite proves it by
+enumeration: a scripted producer decomposes ``push`` into its atomic
+store steps (odd stamp, head bump, length, payload halves, even
+stamp), a scripted scheduler runs every consumer-attempt placement
+between those steps, and every crash point leaves the documented
+skip_torn epitaph.
+
+The payload halves are written in separate steps with distinct byte
+patterns, so a decode of a half-written slot cannot go unnoticed —
+the torn value differs from every payload ever pushed.
+"""
+
+import itertools
+
+import pytest
+
+from handyrl_tpu.pipeline import shm as shm_mod
+from handyrl_tpu.pipeline.shm import ShmRing
+
+_Q = shm_mod._Q
+_HEAD = shm_mod._HEAD
+_SLOT_HDR = shm_mod._SLOT_HDR
+
+
+def _payload(n, size=16):
+    """Per-item payload whose halves differ from each other and from
+    every other item's: a tear is always byte-visible."""
+    half = size // 2
+    return bytes([0x40 + 2 * n]) * half + bytes([0x41 + 2 * n]) * half
+
+
+def producer_steps(ring, item, payload):
+    """``push`` for the ``item``-th slot, decomposed into the protocol's
+    atomic stores — same order as ShmRing.push, with the payload copy
+    split in half to expose mid-write states."""
+    head = item                 # SPSC: heads are sequential
+    off = ring._slot_off(head)
+    half = len(payload) // 2
+
+    def stamp_odd():
+        _Q.pack_into(ring._buf, off, 2 * head + 1)
+
+    def bump_head():
+        ring._set(_HEAD, head + 1)
+
+    def write_len():
+        _Q.pack_into(ring._buf, off + 8, len(payload))
+
+    def write_first_half():
+        ring._buf[off + _SLOT_HDR: off + _SLOT_HDR + half] = \
+            payload[:half]
+
+    def write_second_half():
+        ring._buf[off + _SLOT_HDR + half: off + _SLOT_HDR
+                  + len(payload)] = payload[half:]
+
+    def stamp_even():
+        _Q.pack_into(ring._buf, off, 2 * head + 2)
+
+    return [stamp_odd, bump_head, write_len, write_first_half,
+            write_second_half, stamp_even]
+
+
+@pytest.fixture
+def ring():
+    r = ShmRing.create(slots=4, slot_bytes=64)
+    yield r
+    r.close()
+
+
+N_STEPS = 6
+
+
+def test_single_item_every_interleaving_point(ring):
+    """A consumer attempt after EVERY producer step prefix: pop yields
+    the payload only once all six stores have landed, and what it
+    yields is byte-identical — no prefix ever decodes."""
+    for k in range(N_STEPS + 1):
+        r = ShmRing.create(slots=4, slot_bytes=64)
+        try:
+            payload = _payload(0)
+            steps = producer_steps(r, 0, payload)
+            for step in steps[:k]:
+                step()
+            got = r.pop(loads=bytes)
+            if k < N_STEPS:
+                assert got is None, (
+                    f"pop decoded after only {k}/6 producer steps: "
+                    f"{got!r}")
+                assert not r.readable()
+                # the reservation (odd stamp + head bump) is visible
+                # exactly from step 2 on — the torn-slot signal
+                assert r.pending() == (k >= 2)
+            else:
+                assert got == payload
+                assert len(r) == 0
+        finally:
+            r.close()
+
+
+def test_two_items_all_consumer_placements(ring):
+    """Two pushes (12 producer steps) with consumer attempts at every
+    (i, j) placement pair: every successful pop is one of the two
+    payloads, in push order, byte-identical, and never more than two
+    pops succeed."""
+    payloads = [_payload(0), _payload(1)]
+    for i, j in itertools.combinations_with_replacement(
+            range(2 * N_STEPS + 1), 2):
+        r = ShmRing.create(slots=4, slot_bytes=64)
+        try:
+            steps = (producer_steps(r, 0, payloads[0])
+                     + producer_steps(r, 1, payloads[1]))
+            popped = []
+
+            def drain(rr=r, out=popped):
+                while True:
+                    got = rr.pop(loads=bytes)
+                    if got is None:
+                        return
+                    out.append(got)
+
+            for step in steps[:i]:
+                step()
+            drain()
+            for step in steps[i:j]:
+                step()
+            drain()
+            for step in steps[j:]:
+                step()
+            drain()
+            assert popped == payloads, (
+                f"schedule (pop@{i}, pop@{j}): popped {popped!r}")
+        finally:
+            r.close()
+
+
+def test_crash_at_every_point_leaves_detectable_state(ring):
+    """The producer dies after k steps.  For every k: a complete-looking
+    decode never appears; if the reservation was published the slot is
+    pending-but-unreadable and ``skip_torn`` reclaims it; a successor
+    producer (same cursor discipline as crash-reattach) then flows."""
+    for k in range(N_STEPS):
+        r = ShmRing.create(slots=4, slot_bytes=64)
+        try:
+            dead_payload = _payload(0)
+            for step in producer_steps(r, 0, dead_payload)[:k]:
+                step()
+            # nothing decodable, whatever the crash point
+            assert r.pop(loads=bytes) is None
+            assert not r.readable()
+            if k < 2:
+                # died before the head bump: the reservation never
+                # published, the slot simply does not exist yet
+                assert not r.pending()
+                assert not r.skip_torn()
+                successor_item = 0
+            else:
+                # reservation visible, payload incomplete: the
+                # documented torn state, reclaimable exactly once
+                assert r.pending()
+                assert r.skip_torn()
+                assert r.torn_count == 1
+                assert not r.pending()
+                assert not r.skip_torn()
+                successor_item = 1
+            # the successor producer resumes at the shared HEAD cursor
+            fresh = _payload(3)
+            for step in producer_steps(r, successor_item, fresh):
+                step()
+            assert r.pop(loads=bytes) == fresh
+            assert len(r) == 0
+        finally:
+            r.close()
+
+
+def test_wraparound_reuses_slot_without_stale_decode():
+    """After a full lap the producer re-stamps a previously used slot:
+    at every mid-write point of the reusing push, the consumer must NOT
+    decode the slot's PREVIOUS payload (the stale even stamp belongs to
+    an earlier lap and fails the ``2*tail+2`` check)."""
+    slots = 2
+    for k in range(N_STEPS):
+        r = ShmRing.create(slots=slots, slot_bytes=64)
+        try:
+            # lap 0: fill and drain both slots completely
+            old = [_payload(0), _payload(1)]
+            for item in range(slots):
+                for step in producer_steps(r, item, old[item]):
+                    step()
+            assert r.pop(loads=bytes) == old[0]
+            assert r.pop(loads=bytes) == old[1]
+            # lap 1: reuse slot 0, producer paused after k steps
+            new = _payload(2)
+            for step in producer_steps(r, slots, new)[:k]:
+                step()
+            got = r.pop(loads=bytes)
+            assert got is None, (
+                f"stale/torn decode at reuse step {k}: {got!r}")
+            assert not r.readable()
+        finally:
+            r.close()
+
+
+def test_full_ring_push_refuses_instead_of_overwriting():
+    """Backpressure interleaving: with every slot occupied, the REAL
+    push refuses and counts — the unread payloads survive bytewise."""
+    r = ShmRing.create(slots=2, slot_bytes=64)
+    try:
+        payloads = [_payload(0), _payload(1)]
+        for item in range(2):
+            for step in producer_steps(r, item, payloads[item]):
+                step()
+        assert not r.push(_payload(2))
+        assert r.full_count == 1
+        assert r.pop(loads=bytes) == payloads[0]
+        assert r.pop(loads=bytes) == payloads[1]
+    finally:
+        r.close()
+
+
+def test_scripted_steps_match_real_push():
+    """The decomposition is honest: running all six scripted steps
+    leaves the exact bytes (header + slot) the real ``push`` writes."""
+    scripted = ShmRing.create(slots=4, slot_bytes=64)
+    real = ShmRing.create(slots=4, slot_bytes=64)
+    try:
+        payload = _payload(5)
+        for step in producer_steps(scripted, 0, payload):
+            step()
+        assert real.push(payload)
+        used = shm_mod._HDR + _SLOT_HDR + len(payload)
+        assert bytes(scripted._buf[:used]) == bytes(real._buf[:used])
+    finally:
+        scripted.close()
+        real.close()

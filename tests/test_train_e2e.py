@@ -103,6 +103,11 @@ def test_local_training_two_epochs(tmp_path, monkeypatch):
         # no peer spoke a verb the server does not handle
         assert record["stall_events"] == 0
         assert record["unknown_verbs"] == 0
+        # the lock-order guard is armed by default: every epoch reports
+        # its contention window and the run never observed two locks
+        # taken in conflicting orders
+        assert "lock_contention_sec" in record
+        assert record["lock_order_inversions"] == 0
         # pipeline telemetry, present EVERY epoch: off-policy staleness
         # is finite and the epoch's wall time splits into feed wait vs
         # device work (batch_wait_sec is 0.0 on the device-replay path
