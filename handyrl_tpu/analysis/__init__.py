@@ -20,12 +20,23 @@ trace-stable as the codebase grows:
     package's ``(verb, payload)`` protocol graph (sent vs handled
     verbs, request/reply round trips) and checks blocking recvs,
     payload picklability, and fork safety around it.
+  * :mod:`handyrl_tpu.analysis.racelint` + ``racerules`` — the
+    thread-safety layer (``--race``): the thread-spawn graph and lock
+    environment behind the unguarded-write/lock-order rules.
+  * :mod:`handyrl_tpu.analysis.numlint` + ``numrules`` — the
+    dtype/precision-flow layer (``--num``): an interprocedural dtype
+    lattice (bf16/fp32/uint8/weak scalars, the ``compute_dtype`` /
+    ``obs_store`` config facts) behind the implicit-upcast /
+    lowp-accum / unguarded-cast / nonfinite-risk rules.
   * :mod:`handyrl_tpu.analysis.guards` — runtime guards that measure
     what the linters cannot prove: ``RetraceGuard`` (compile counts of
     the update step), ``HostTransferGuard`` (device->host transfer
     counts per epoch), ``ShardingContractGuard`` (resharding copies at
-    the update step's boundary), and ``StallWatchdog`` (silent wedges
-    of the control-plane loops, per-epoch ``stall_events``).
+    the update step's boundary), ``StallWatchdog`` (silent wedges
+    of the control-plane loops, per-epoch ``stall_events``),
+    ``LockOrderGuard`` (lock contention/ordering at runtime), and
+    ``NumericsGuard`` (dtype-contract breaks + nonfinite update
+    steps at the jit boundary).
 
 Guard classes are re-exported lazily (PEP 562) so importing the
 analysis package — e.g. from the jaxlint CLI — never pulls in jax.
@@ -33,7 +44,8 @@ analysis package — e.g. from the jaxlint CLI — never pulls in jax.
 
 _GUARD_EXPORTS = ("RetraceGuard", "RetraceError", "HostTransferGuard",
                   "HostTransferError", "ShardingContractGuard",
-                  "ShardingContractError", "StallWatchdog")
+                  "ShardingContractError", "StallWatchdog",
+                  "NumericsGuard", "NumericsError")
 
 __all__ = list(_GUARD_EXPORTS)
 

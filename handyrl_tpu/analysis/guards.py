@@ -35,6 +35,19 @@ knows:
     runtime complement of commlint's ``unbounded-recv``/
     ``reply-mismatch`` rules, catching the wedges the analyzer could
     not prove (or that a suppression claimed were bounded).
+  * :class:`NumericsGuard` wraps the update step and latches the
+    per-leaf dtype treedef of its arguments at first call: a later
+    call whose leaf arrives with a different concrete dtype is a
+    counted ``numerics_contract_break`` (the runtime twin of
+    numlint's ``dtype-split-brain``/``implicit-upcast`` rules), and a
+    weak<->concrete flip is a counted ``weak_upcast`` (the runtime
+    twin of ``weak-type-promotion`` — each flip is also a fresh jit
+    cache entry).  It also counts nonfinite update steps: the step
+    computes a cheap in-graph flag over the loss and grad global
+    norm (see ``ops/update.py``), the learner feeds the fetched
+    per-step flags to :meth:`NumericsGuard.note_step` at the epoch
+    boundary (no extra host syncs), and ``max_nonfinite_steps > 0``
+    turns the count into a hard :class:`NumericsError` budget.
   * :class:`LockOrderGuard` wraps the package's lock objects in
     timing/ordering proxies: per-epoch ``lock_contention_sec`` (wall
     time threads spent waiting on guarded locks) and
@@ -69,6 +82,10 @@ class HostTransferError(RuntimeError):
 
 class ShardingContractError(RuntimeError):
     """More resharding copies at a jit boundary than the budget."""
+
+
+class NumericsError(RuntimeError):
+    """More nonfinite update steps than the armed budget allows."""
 
 
 class _GuardedJit:
@@ -303,6 +320,185 @@ class ShardingContractGuard:
         delta = self.copies - self._last_snapshot
         self._last_snapshot = self.copies
         return delta
+
+
+class _DtypeCall:
+    """Callable proxy that checks one jitted fn's dtype contract.
+
+    Each argument treedef latches a per-leaf ``(dtype, weak_type)``
+    signature at first call.  A later call whose leaf arrives with a
+    different *concrete* dtype is a contract break — the jit silently
+    retraces (or upcasts) and the mixed-precision regime's declared
+    boundary is gone.  A weak<->concrete flip (or a weak Python
+    scalar changing type) is a weak upcast: cheaper, but each flip is
+    its own jit cache entry and its own promotion hazard.  A NEW
+    treedef is a different program and gets a fresh contract, exactly
+    like :class:`_ShardedCall`; host-side leaves that are neither
+    arrays nor Python scalars are skipped.  Signatures are read
+    BEFORE the call (donated buffers are dead after) and sampled on
+    the :class:`_GuardedJit` schedule.
+    """
+
+    WARM_CALLS = _GuardedJit.WARM_CALLS
+    SAMPLE_EVERY = _GuardedJit.SAMPLE_EVERY
+
+    def __init__(self, guard, fn):
+        self._guard = guard
+        self._fn = fn
+        self._contracts = {}
+        self._calls = 0
+        self.contract_breaks = 0
+        self.weak_upcasts = 0
+
+    @staticmethod
+    def _leaf_sig(leaf):
+        dtype = getattr(leaf, "dtype", None)
+        if dtype is not None:
+            return (str(dtype), bool(getattr(leaf, "weak_type", False)))
+        if isinstance(leaf, (bool, int, float)):
+            return (type(leaf).__name__, True)
+        return None  # host-side leaf with no dtype story
+
+    def _check(self, args, kwargs):
+        leaves, treedef = jax.tree.flatten((args, kwargs))
+        contract = self._contracts.get(treedef)
+        if contract is None or len(contract) != len(leaves):
+            contract = self._contracts[treedef] = [None] * len(leaves)
+        breaks = upcasts = 0
+        for i, leaf in enumerate(leaves):
+            sig = self._leaf_sig(leaf)
+            if sig is None:
+                continue
+            if contract[i] is None:
+                contract[i] = sig
+                continue
+            if sig == contract[i]:
+                continue
+            (dtype0, weak0), (dtype1, weak1) = contract[i], sig
+            if weak0 or weak1:
+                upcasts += 1
+            elif dtype0 != dtype1:
+                breaks += 1
+        if breaks or upcasts:
+            self.contract_breaks += breaks
+            self.weak_upcasts += upcasts
+
+    def __call__(self, *args, **kwargs):
+        self._calls += 1
+        if (self._calls <= self.WARM_CALLS
+                or self._calls % self.SAMPLE_EVERY == 0):
+            self._check(args, kwargs)
+        return self._fn(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
+
+
+class NumericsGuard:
+    """Dtype-contract + nonfinite-step accounting for the update step.
+
+    ::
+
+        guard = NumericsGuard(max_nonfinite=0, name="update_step")
+        step = guard.wrap(make_update_step(...))
+        ...
+        guard.note_step(m["nonfinite"])   # per step, at epoch fetch
+        guard.snapshot()                  # per-epoch metric deltas
+
+    Two independent counters ride one guard:
+
+      * **dtype contract** — :meth:`wrap` proxies the jitted step
+        through :class:`_DtypeCall`, which latches each argument
+        leaf's ``(dtype, weak_type)`` at first call and counts later
+        divergence (``numerics_contract_breaks`` for concrete flips,
+        ``weak_upcasts`` for weak-type churn).  Steady state is 0/0:
+        params and optimizer state are donated back unchanged and
+        batches arrive staged on the pipeline's fixed dtypes.
+      * **nonfinite steps** — the update step computes a scalar
+        in-graph flag (loss or grad-global-norm nonfinite, see
+        ``ops/update.py``) that rides the per-step metrics dict; the
+        learner feeds the flags to :meth:`note_step` at the epoch
+        boundary, after the ONE ``jax.device_get`` it already does —
+        zero extra host traffic.  ``max_nonfinite > 0`` raises
+        :class:`NumericsError` when the cumulative count exceeds the
+        budget (the default 0 counts without asserting, matching the
+        other guards).
+
+    ``enabled=False`` makes the guard a true no-op: :meth:`wrap`
+    returns its argument unchanged and every counter stays 0.
+    """
+
+    def __init__(self, max_nonfinite: int = 0, name: str = "jit",
+                 enabled: bool = True):
+        self.max_nonfinite = int(max_nonfinite or 0)
+        self.name = name
+        self.enabled = bool(enabled)
+        self.nonfinite_steps = 0
+        self._last_nonfinite = 0
+        self._last_breaks = 0
+        self._last_upcasts = 0
+        self._wrapped = []
+
+    def wrap(self, fn):
+        """Wrap a jitted callable; returns the checking proxy (or
+        ``fn`` itself when the guard is disabled)."""
+        if not self.enabled:
+            return fn
+        proxy = _DtypeCall(self, fn)
+        self._wrapped.append(proxy)
+        return proxy
+
+    @property
+    def contract_breaks(self) -> int:
+        return sum(p.contract_breaks for p in self._wrapped)
+
+    @property
+    def weak_upcasts(self) -> int:
+        return sum(p.weak_upcasts for p in self._wrapped)
+
+    def note_step(self, flag) -> bool:
+        """Count one update step's nonfinite flag (0.0 clean, 1.0
+        poisoned — at most one count per step by construction).
+        Returns whether the step was nonfinite."""
+        if not self.enabled:
+            return False
+        try:
+            bad = float(flag) >= 0.5
+        except (TypeError, ValueError):
+            return False
+        if bad:
+            self.nonfinite_steps += 1
+            if self.max_nonfinite \
+                    and self.nonfinite_steps > self.max_nonfinite:
+                raise NumericsError(
+                    f"{self.name}: {self.nonfinite_steps} nonfinite "
+                    f"update steps (budget {self.max_nonfinite}) — "
+                    f"the loss or gradient went NaN/Inf; check the "
+                    f"nonfinite-risk lint findings and the lr/clip "
+                    f"settings before the parameters are unrecoverable")
+        return bad
+
+    def snapshot(self) -> dict:
+        """Per-epoch deltas since the previous snapshot, keyed exactly
+        as the metrics jsonl expects."""
+        breaks, upcasts = self.contract_breaks, self.weak_upcasts
+        out = {
+            "nonfinite_steps": self.nonfinite_steps
+            - self._last_nonfinite,
+            "numerics_contract_breaks": breaks - self._last_breaks,
+            "weak_upcasts": upcasts - self._last_upcasts,
+        }
+        self._last_nonfinite = self.nonfinite_steps
+        self._last_breaks = breaks
+        self._last_upcasts = upcasts
+        return out
+
+    def stats(self) -> dict:
+        """Cumulative totals for the status endpoint."""
+        return {"nonfinite_steps": self.nonfinite_steps,
+                "numerics_contract_breaks": self.contract_breaks,
+                "weak_upcasts": self.weak_upcasts,
+                "max_nonfinite_steps": self.max_nonfinite}
 
 
 class StallWatchdog:

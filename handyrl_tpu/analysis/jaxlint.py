@@ -11,6 +11,7 @@ Usage::
     python -m handyrl_tpu.analysis.jaxlint --shard handyrl_tpu/
     python -m handyrl_tpu.analysis.jaxlint --comm handyrl_tpu/
     python -m handyrl_tpu.analysis.jaxlint --race handyrl_tpu/
+    python -m handyrl_tpu.analysis.jaxlint --num handyrl_tpu/
     python -m handyrl_tpu.analysis.jaxlint --sarif handyrl_tpu/
     python -m handyrl_tpu.analysis.jaxlint --list-rules
     handyrl-jaxlint handyrl_tpu/            # console-script entry
@@ -23,10 +24,13 @@ wedges, unbounded recvs, unpicklable payloads, fork safety) and
 ``--race`` the thread-safety rule set (:mod:`.racerules` — unguarded
 shared writes, non-atomic read-modify-writes, live-container
 iteration, lock-order cycles, blocking under a lock, leaked
-acquires); the flags compose.  ``--sarif`` emits SARIF 2.1.0 for
-GitHub code scanning; ``--exclude`` drops path prefixes (e.g. test
-fixtures) from directory scans.  ``--list-rules`` always prints all
-four rule families.
+acquires) and ``--num`` the dtype/precision-flow rule set
+(:mod:`.numrules` — implicit upcasts, weak-type promotion, bf16
+accumulation, unguarded lossy casts, split-brain return dtypes,
+nonfinite producers); the flags compose.  ``--sarif`` emits SARIF
+2.1.0 for GitHub code scanning; ``--exclude`` drops path prefixes
+(e.g. test fixtures) from directory scans.  ``--list-rules`` always
+prints all five rule families.
 
 Exit status: 0 when clean, 1 when any finding survives suppression,
 2 on usage/IO errors.
@@ -212,11 +216,12 @@ def load_package(paths: List[str], exclude: Optional[List[str]] = None):
 
 def active_registry(shard: bool = False,
                     comm: bool = False,
-                    race: bool = False) -> Dict[str, "object"]:
+                    race: bool = False,
+                    num: bool = False) -> Dict[str, "object"]:
     """The rule registry in force: jaxlint's base rules, plus the
     shardlint rules with ``shard=True``, the commlint rules with
-    ``comm=True``, and the racelint rules with ``race=True`` (the
-    flags compose)."""
+    ``comm=True``, the racelint rules with ``race=True``, and the
+    numlint rules with ``num=True`` (the flags compose)."""
     registry = dict(RULES)
     if shard:
         from .shardrules import SHARD_RULES
@@ -230,6 +235,10 @@ def active_registry(shard: bool = False,
         from .racerules import RACE_RULES
 
         registry.update(RACE_RULES)
+    if num:
+        from .numrules import NUM_RULES
+
+        registry.update(NUM_RULES)
     return registry
 
 
@@ -238,6 +247,7 @@ def lint_paths(paths: List[str],
                shard: bool = False,
                comm: bool = False,
                race: bool = False,
+               num: bool = False,
                exclude: Optional[List[str]] = None) -> List[Finding]:
     """Run the (selected) rules over ``paths``; returns surviving
     findings sorted by location."""
@@ -248,7 +258,7 @@ def lint_paths(paths: List[str],
     ]
     compute_tracer_taint(package)
     compute_device_summaries(package)
-    registry = active_registry(shard, comm, race)
+    registry = active_registry(shard, comm, race, num)
     active = [registry[r] for r in (select or sorted(registry))]
     for mod in package.modules.values():
         supp = suppressions[mod.path]
@@ -271,13 +281,14 @@ def lint_source(source: str, name: str = "<string>",
                 select: Optional[List[str]] = None,
                 shard: bool = False,
                 comm: bool = False,
-                race: bool = False) -> List[Finding]:
+                race: bool = False,
+                num: bool = False) -> List[Finding]:
     """Lint one in-memory module (test/fixture helper)."""
     module = ModuleInfo(name, name, source)
     package = Package([module])
     compute_tracer_taint(package)
     compute_device_summaries(package)
-    registry = active_registry(shard, comm, race)
+    registry = active_registry(shard, comm, race, num)
     supp = Suppressions(source, name)
     findings: List[Finding] = []
     if supp.skip_file:
@@ -396,6 +407,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--race", action="store_true",
                         help="also run the thread-safety/lock-order "
                              "rules (racelint)")
+    parser.add_argument("--num", action="store_true",
+                        help="also run the dtype/precision-flow "
+                             "rules (numlint)")
     parser.add_argument("--select", default=None,
                         help="comma-separated rule ids to run "
                              "(default: all)")
@@ -407,12 +421,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="print the rule registry and exit")
     args = parser.parse_args(argv)
 
-    registry = active_registry(args.shard, args.comm, args.race)
+    registry = active_registry(args.shard, args.comm, args.race,
+                               args.num)
     if args.list_rules:
         # the rule LISTING is documentation, not a gate: always show
-        # every registered family (jax + shard + comm + race) with
-        # its doc
-        _print_rules(active_registry(shard=True, comm=True, race=True))
+        # every registered family (jax + shard + comm + race + num)
+        # with its doc
+        _print_rules(active_registry(shard=True, comm=True, race=True,
+                                     num=True))
         return 0
     if args.json and args.sarif:
         print("jaxlint: --json and --sarif are mutually exclusive",
@@ -432,7 +448,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         findings = lint_paths(paths, select=select, shard=args.shard,
                               comm=args.comm, race=args.race,
-                              exclude=args.exclude)
+                              num=args.num, exclude=args.exclude)
     except FileNotFoundError as exc:
         print(f"jaxlint: no such path: {exc}", file=sys.stderr)
         return 2

@@ -1,0 +1,741 @@
+"""numlint: interprocedural dtype/precision-flow analysis for jaxlint.
+
+The mixed-precision regime (master fp32 params + bf16 compute, see
+``ops/update.py``) only pays on the MXU while the hot path actually
+*stays* in bf16 — one stray ``np.float32`` constant or a weak-typed
+Python scalar concretized through ``jnp.asarray`` silently promotes a
+fused matmul back to fp32 and the MFU campaign loses its margin
+without a single test failing.  This module is the dataflow engine
+behind the ``numrules`` family: it tracks a small dtype lattice
+through the package so the rules can ask "what dtype is this
+expression, really?" instead of pattern-matching spellings.
+
+The lattice fact is :class:`DtypeFact` — a canonical dtype name
+(``bfloat16 / float16 / float32 / float64 / int / uint8 / int8 /
+bool``) plus two qualifiers:
+
+  ``weak``       a Python scalar (``0.5``, ``2``) whose JAX weak-type
+                 promotion follows the *other* operand — harmless in
+                 arithmetic, the whole point of writing ``h * 0.5``;
+  ``from_weak``  a weak scalar needlessly concretized
+                 (``jnp.asarray(0.5)`` with no ``dtype=``) — now a
+                 committed fp32 array that DOES drag bf16 operands up.
+
+Facts flow interprocedurally through four channels, built to a
+package fixpoint (:class:`NumAnalysis`):
+
+  * **config facts** — assignments to ``compute_dtype`` /
+    ``obs_store`` anywhere in the package contribute their dtype
+    tokens (string literals, ``np.uint8``-style attributes), so
+    ``jnp.dtype(self.compute_dtype)`` resolves to the configured
+    ``{bfloat16}`` and the shm observation store's ``uint8`` wire
+    format is a known fact;
+  * **dtype-value bindings** — ``dtype = jnp.dtype(compute_dtype)``
+    binds a *set* of possible dtype names to a local, chased through
+    closures and call arguments into ``astype``/``dtype=`` sites;
+  * **array facts** — ``h = x.astype(jnp.bfloat16)`` binds a concrete
+    DtypeFact to a local; ``.sum()/.mean()``-style methods and the
+    ``jnp.*`` producers pass facts through; binary ops promote facts
+    with JAX's weak-type semantics;
+  * **function summaries** — definite, non-weak argument facts seed
+    callee parameters (conflicting call sites collapse the parameter
+    to unknown), and a function whose every return carries the same
+    fact exports it as a return summary.
+
+Everything is stdlib ``ast`` — numlint never imports jax or numpy, so
+it runs with the rest of jaxlint in CI/pre-commit in milliseconds.
+Like the other analyzers the lattice is *approximate and monotone in
+spirit*: unknown stays unknown (rules only fire on definite facts),
+which keeps the false-positive rate near zero at the cost of missing
+dynamically-chosen dtypes.
+"""
+
+import ast
+from collections import deque
+from dataclasses import dataclass
+from typing import Dict, FrozenSet, List, Optional, Set
+
+from .astutil import (FunctionInfo, ModuleInfo, Package, _walk_calls,
+                      compute_tracer_taint, dotted_parts)
+
+# Canonical spellings.  All integer widths >= 16 collapse to "int":
+# the rules only care about float precision, the lossy 8-bit targets,
+# and bool masks.
+_DTYPE_TOKENS = {
+    "bfloat16": "bfloat16", "bf16": "bfloat16",
+    "float16": "float16", "fp16": "float16", "half": "float16",
+    "float32": "float32", "fp32": "float32", "single": "float32",
+    "float64": "float64", "fp64": "float64", "double": "float64",
+    "uint8": "uint8", "ubyte": "uint8",
+    "int8": "int8", "byte": "int8",
+    "int16": "int", "int32": "int", "int64": "int", "int": "int",
+    "uint16": "int", "uint32": "int", "uint64": "int", "uint": "int",
+    "bool": "bool", "bool_": "bool",
+}
+
+LOW_PRECISION = frozenset({"bfloat16", "float16"})
+HIGH_PRECISION = frozenset({"float32", "float64"})
+LOSSY_TARGETS = frozenset({"uint8", "int8"})
+
+# Assignment targets (plain names or ``self.<key>`` attributes)
+# harvested package-wide as configuration facts.
+CONFIG_FACT_KEYS = ("compute_dtype", "obs_store")
+
+_FLOAT_RANK = {"bfloat16": 1, "float16": 1, "float32": 2, "float64": 3}
+
+# numpy/jax.numpy prefixes under which a trailing dtype token is a
+# dtype *value* (``np.float32``) or constructor (``np.float32(0.5)``).
+_DTYPE_NAMESPACES = ("numpy.", "jax.numpy.")
+
+# jnp producers that default to float32 when no dtype is passed.
+_F32_DEFAULT_PRODUCERS = frozenset({
+    "jax.numpy.zeros", "jax.numpy.ones", "jax.numpy.empty",
+    "jax.numpy.eye", "jax.numpy.linspace",
+})
+
+_ASARRAY_FNS = frozenset({
+    "jax.numpy.asarray", "jax.numpy.array",
+    "numpy.asarray", "numpy.array",
+})
+
+# jnp/lax calls whose result is a bool mask / index, never the input
+# dtype — blocking the generic passthrough below.
+_NON_PASSTHROUGH = frozenset({
+    "jax.numpy.isfinite", "jax.numpy.isnan", "jax.numpy.isinf",
+    "jax.numpy.isclose", "jax.numpy.allclose", "jax.numpy.array_equal",
+    "jax.numpy.equal", "jax.numpy.not_equal", "jax.numpy.less",
+    "jax.numpy.less_equal", "jax.numpy.greater",
+    "jax.numpy.greater_equal", "jax.numpy.logical_and",
+    "jax.numpy.logical_or", "jax.numpy.logical_not",
+    "jax.numpy.argmax", "jax.numpy.argmin", "jax.numpy.argsort",
+    "jax.numpy.shape", "jax.numpy.ndim", "jax.numpy.size",
+    "jax.numpy.sign", "jax.numpy.nonzero", "jax.numpy.where",
+})
+
+# dtype-passthrough method calls (``x.sum()`` has x's dtype).
+_PASSTHROUGH_METHODS = frozenset({
+    "sum", "mean", "dot", "cumsum", "var", "std", "max", "min",
+    "reshape", "transpose", "copy", "squeeze", "ravel", "flatten",
+    "clip", "take", "swapaxes",
+})
+
+DTYPE_KWARGS = ("dtype", "preferred_element_type")
+
+# Transforms whose function argument runs inside compiled compute even
+# when the jit wrapper itself is applied to an unresolvable value
+# (``jax.jit(core)`` where ``core`` is a factory parameter — the
+# update-step idiom the base jit-entry scan cannot see through).
+_COMPUTE_WRAPPERS = frozenset({
+    "jax.grad", "jax.value_and_grad", "jax.vmap", "jax.checkpoint",
+    "jax.remat", "jax.lax.scan", "jax.lax.cond", "jax.lax.while_loop",
+    "jax.lax.fori_loop", "jax.lax.map", "jax.lax.associative_scan",
+    "jax.custom_vjp", "jax.custom_jvp",
+})
+
+
+def parse_dtype(token: Optional[str]) -> Optional[str]:
+    """A dtype spelling (possibly dotted: ``np.float32``) -> canonical
+    lattice name, or None if it names no dtype."""
+    if not token:
+        return None
+    return _DTYPE_TOKENS.get(token.split(".")[-1].lower())
+
+
+@dataclass(frozen=True)
+class DtypeFact:
+    """One lattice point: a canonical dtype + weak-type qualifiers."""
+
+    dtype: str
+    weak: bool = False        # Python scalar; promotion follows peers
+    from_weak: bool = False   # weak scalar concretized w/o dtype=
+
+
+def promote(a: Optional[DtypeFact],
+            b: Optional[DtypeFact]) -> Optional[DtypeFact]:
+    """JAX-style binary promotion over the lattice; None is absorbing
+    (unknown in -> unknown out)."""
+    if a is None or b is None:
+        return None
+    if a.dtype == b.dtype:
+        return DtypeFact(a.dtype, a.weak and b.weak,
+                         a.from_weak and b.from_weak)
+    fa, fb = a.dtype in _FLOAT_RANK, b.dtype in _FLOAT_RANK
+    if fa and fb:
+        if a.weak != b.weak:
+            # weak scalars do NOT promote concrete floats
+            concrete = b if a.weak else a
+            return DtypeFact(concrete.dtype, False, concrete.from_weak)
+        ra, rb = _FLOAT_RANK[a.dtype], _FLOAT_RANK[b.dtype]
+        if ra == rb:  # bfloat16 x float16 -> float32
+            return DtypeFact("float32")
+        return DtypeFact(a.dtype if ra > rb else b.dtype,
+                         a.weak and b.weak)
+    if fa or fb:
+        f, other = (a, b) if fa else (b, a)
+        if f.weak and not other.weak:
+            # python float + concrete int array -> float32
+            return DtypeFact("float32")
+        return DtypeFact(f.dtype, f.weak and other.weak, f.from_weak)
+    if a.dtype == "bool":
+        return b
+    if b.dtype == "bool":
+        return a
+    return DtypeFact("int", a.weak and b.weak)
+
+
+# sentinel: a callee parameter seeded with incompatible facts from
+# different call sites — the summary collapses to "unknown"
+_CONFLICT = object()
+
+
+def _own_stmts(fn: FunctionInfo) -> List[ast.stmt]:
+    node = fn.node
+    if isinstance(node, ast.Lambda):
+        return [ast.Expr(node.body)]
+    return list(node.body)
+
+
+def _own_nodes(fn: FunctionInfo):
+    """Every node in fn's own body, excluding nested def/lambda
+    bodies (those scan as their own FunctionInfos)."""
+    out = []
+
+    def walk(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue
+            out.append(child)
+            walk(child)
+
+    for stmt in _own_stmts(fn):
+        out.append(stmt)
+        walk(stmt)
+    return out
+
+
+class NumAnalysis:
+    """Package-wide dtype/precision facts (see module docstring)."""
+
+    MAX_PASSES = 5
+
+    def __init__(self, package: Package):
+        self.package = package
+        # config key -> dtype tokens harvested from every assignment
+        self.config_facts: Dict[str, FrozenSet[str]] = {}
+        # per-function array-fact environment (local name -> fact)
+        self.env: Dict[FunctionInfo, Dict[str, DtypeFact]] = {}
+        # per-function dtype-VALUE environment (name -> possible dtypes)
+        self.dtype_env: Dict[FunctionInfo, Dict[str, FrozenSet[str]]] = {}
+        # callee parameter facts seeded from call sites
+        self.param_facts: Dict[FunctionInfo, Dict[str, object]] = {}
+        self.param_dtypes: Dict[FunctionInfo, Dict[str, Set[str]]] = {}
+        # return summaries (all returns known + equal)
+        self.returns: Dict[FunctionInfo, DtypeFact] = {}
+        # dtype names each function casts to (astype/asarray/dtype=)
+        self.fn_casts: Dict[FunctionInfo, Set[str]] = {}
+        # functions that run inside compiled compute: jit-reachable
+        # (per astutil) plus grad/scan/vmap closures and everything
+        # they call — the precision rules' scope
+        self.compute_fns: Set[FunctionInfo] = set()
+        for fn in package.all_functions():
+            self.env[fn] = {}
+            self.dtype_env[fn] = {}
+            self.param_facts[fn] = {}
+            self.param_dtypes[fn] = {}
+            self.fn_casts[fn] = set()
+        # the compute-set seed reads fn.jit_reachable, which only the
+        # base engine's taint pass computes — run it here (idempotent)
+        # so analyze_num works on a bare Package too, not just after
+        # lint_paths has primed the flags
+        compute_tracer_taint(package)
+        self._collect_config_facts()
+        self._seed_param_defaults()
+        self._build_envs()
+        self._build_compute_set()
+
+    # -- config facts -------------------------------------------------
+
+    def _collect_config_facts(self):
+        found: Dict[str, Set[str]] = {k: set() for k in CONFIG_FACT_KEYS}
+        for mod in self.package.modules.values():
+            for node in ast.walk(mod.tree):
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                    value = node.value
+                elif isinstance(node, ast.AnnAssign) and node.value:
+                    targets = [node.target]
+                    value = node.value
+                else:
+                    continue
+                for tgt in targets:
+                    key = None
+                    if isinstance(tgt, ast.Name):
+                        key = tgt.id
+                    elif isinstance(tgt, ast.Attribute):
+                        key = tgt.attr
+                    if key in CONFIG_FACT_KEYS:
+                        found[key] |= self._dtype_tokens_in(value)
+        for key, toks in found.items():
+            if toks:
+                self.config_facts[key] = frozenset(toks)
+
+    @staticmethod
+    def _dtype_tokens_in(expr) -> Set[str]:
+        """Every dtype token mentioned in a subtree (string literals
+        plus ``np.float32``-style attributes)."""
+        toks: Set[str] = set()
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str):
+                d = parse_dtype(node.value)
+                if d is not None:
+                    toks.add(d)
+            elif isinstance(node, ast.Attribute):
+                parts = dotted_parts(node)
+                if parts and parts[0] in ("np", "numpy", "jnp", "jax"):
+                    d = parse_dtype(parts[-1])
+                    if d is not None:
+                        toks.add(d)
+        return toks
+
+    # -- parameter defaults -------------------------------------------
+
+    def _seed_param_defaults(self):
+        for fn in self.package.all_functions():
+            args = fn.node.args
+            pos = args.posonlyargs + args.args
+            for a, default in zip(pos[len(pos) - len(args.defaults):],
+                                  args.defaults):
+                toks = self._dtype_tokens_in(default)
+                if toks:
+                    self.param_dtypes[fn].setdefault(
+                        a.arg, set()).update(toks)
+            for a, default in zip(args.kwonlyargs, args.kw_defaults):
+                if default is None:
+                    continue
+                toks = self._dtype_tokens_in(default)
+                if toks:
+                    self.param_dtypes[fn].setdefault(
+                        a.arg, set()).update(toks)
+            # a parameter literally named after a config fact inherits
+            # the configured values (``def make_apply_fn(model,
+            # compute_dtype=...)`` sees {bfloat16, ...})
+            for key in CONFIG_FACT_KEYS:
+                if key in fn.all_params and key in self.config_facts:
+                    self.param_dtypes[fn].setdefault(
+                        key, set()).update(self.config_facts[key])
+
+    # -- environment fixpoint -----------------------------------------
+
+    def _build_envs(self):
+        for _ in range(self.MAX_PASSES):
+            changed = False
+            for fn in self.package.all_functions():
+                if self._scan_function(fn):
+                    changed = True
+            if not changed:
+                break
+
+    def _scan_function(self, fn: FunctionInfo) -> bool:
+        env: Dict[str, DtypeFact] = {}
+        dtenv: Dict[str, FrozenSet[str]] = {}
+        rets: List[Optional[DtypeFact]] = []
+        for stmt in _own_stmts(fn):
+            self._stmt(fn, stmt, env, dtenv, rets)
+        casts: Set[str] = set()
+        changed = False
+        for node in _own_nodes(fn):
+            if isinstance(node, ast.Call):
+                self._record_cast(fn, node, env, dtenv, casts)
+                if self._seed_callee(fn, node, env, dtenv):
+                    changed = True
+        ret = None
+        if rets and all(r is not None for r in rets) \
+                and len({r for r in rets}) == 1:
+            ret = rets[0]
+        if env != self.env[fn]:
+            self.env[fn] = env
+            changed = True
+        if dtenv != self.dtype_env[fn]:
+            self.dtype_env[fn] = dtenv
+            changed = True
+        if not (casts <= self.fn_casts[fn]):
+            self.fn_casts[fn] |= casts
+            changed = True
+        if ret != self.returns.get(fn):
+            if ret is None:
+                self.returns.pop(fn, None)
+            else:
+                self.returns[fn] = ret
+            changed = True
+        return changed
+
+    # -- statements ---------------------------------------------------
+
+    def _stmt(self, fn, stmt, env, dtenv, rets):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(stmt, ast.Assign):
+            if len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                self._bind(fn, stmt.targets[0].id, stmt.value, env,
+                           dtenv)
+            else:
+                for tgt in stmt.targets:
+                    self._clobber(tgt, env, dtenv)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            if isinstance(stmt.target, ast.Name):
+                self._bind(fn, stmt.target.id, stmt.value, env, dtenv)
+        elif isinstance(stmt, ast.AugAssign):
+            if isinstance(stmt.target, ast.Name):
+                name = stmt.target.id
+                old = env.get(name)
+                new = promote(old, self.fact(fn, stmt.value, env, dtenv))
+                if new is not None:
+                    env[name] = new
+                else:
+                    env.pop(name, None)
+        elif isinstance(stmt, ast.For):
+            self._clobber(stmt.target, env, dtenv)
+            for s in stmt.body + stmt.orelse:
+                self._stmt(fn, s, env, dtenv, rets)
+        elif isinstance(stmt, (ast.While, ast.If)):
+            for s in stmt.body + stmt.orelse:
+                self._stmt(fn, s, env, dtenv, rets)
+        elif isinstance(stmt, ast.With):
+            for item in stmt.items:
+                if item.optional_vars is not None:
+                    self._clobber(item.optional_vars, env, dtenv)
+            for s in stmt.body:
+                self._stmt(fn, s, env, dtenv, rets)
+        elif isinstance(stmt, ast.Try):
+            for s in (stmt.body + stmt.orelse + stmt.finalbody
+                      + [h for hand in stmt.handlers
+                         for h in hand.body]):
+                self._stmt(fn, s, env, dtenv, rets)
+        elif isinstance(stmt, ast.Return):
+            rets.append(self.fact(fn, stmt.value, env, dtenv)
+                        if stmt.value is not None else None)
+
+    def _bind(self, fn, name, value, env, dtenv):
+        dset = self.dtypes(fn, value, env, dtenv)
+        if dset:
+            dtenv[name] = dset
+            env.pop(name, None)
+            return
+        fact = self.fact(fn, value, env, dtenv)
+        if fact is not None:
+            env[name] = fact
+            dtenv.pop(name, None)
+        else:
+            env.pop(name, None)
+            dtenv.pop(name, None)
+
+    def _clobber(self, target, env, dtenv):
+        for node in ast.walk(target):
+            if isinstance(node, ast.Name):
+                env.pop(node.id, None)
+                dtenv.pop(node.id, None)
+
+    # -- call-site fact extraction ------------------------------------
+
+    def _record_cast(self, fn, call: ast.Call, env, dtenv, casts):
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr == "astype" and call.args:
+            dset = self.dtypes(fn, call.args[0], env, dtenv)
+            if dset:
+                casts |= dset
+        name = self.package.full_name(fn.module, fn, call.func)
+        if name in _ASARRAY_FNS and len(call.args) >= 2:
+            dset = self.dtypes(fn, call.args[1], env, dtenv)
+            if dset:
+                casts |= dset
+        for kw in call.keywords:
+            if kw.arg in DTYPE_KWARGS:
+                dset = self.dtypes(fn, kw.value, env, dtenv)
+                if dset:
+                    casts |= dset
+
+    def _seed_callee(self, fn, call: ast.Call, env, dtenv) -> bool:
+        res = self.package.resolve_callee(fn.module, fn, call.func)
+        if res is None or res[0] != "fn":
+            return False
+        callee: FunctionInfo = res[1]
+        changed = False
+        params = callee.callable_params
+        pairs = []
+        for idx, arg in enumerate(call.args):
+            if isinstance(arg, ast.Starred):
+                break
+            if idx < len(params):
+                pairs.append((params[idx], arg))
+        for kw in call.keywords:
+            if kw.arg is not None and kw.arg in callee.all_params:
+                pairs.append((kw.arg, kw.value))
+        for pname, arg in pairs:
+            dset = self.dtypes(fn, arg, env, dtenv)
+            if dset:
+                slot = self.param_dtypes[callee].setdefault(pname, set())
+                if not (dset <= slot):
+                    slot |= dset
+                    changed = True
+            fact = self.fact(fn, arg, env, dtenv)
+            if fact is not None and not fact.weak:
+                cur = self.param_facts[callee].get(pname)
+                if cur is None:
+                    self.param_facts[callee][pname] = fact
+                    changed = True
+                elif cur is not _CONFLICT and cur != fact:
+                    self.param_facts[callee][pname] = _CONFLICT
+                    changed = True
+        return changed
+
+    # -- dtype-VALUE resolution ---------------------------------------
+
+    def dtypes(self, fn: FunctionInfo, e, env=None,
+               dtenv=None) -> Optional[FrozenSet[str]]:
+        """Expression as a dtype *value* -> the set of canonical dtype
+        names it may denote (None: not a dtype value / unresolvable)."""
+        if e is None:
+            return None
+        if dtenv is None:
+            dtenv = self.dtype_env.get(fn, {})
+        if isinstance(e, ast.Constant) and isinstance(e.value, str):
+            d = parse_dtype(e.value)
+            return frozenset({d}) if d else None
+        if isinstance(e, ast.Attribute):
+            parts = dotted_parts(e)
+            if parts and len(parts) == 2 and parts[0] == "self" \
+                    and parts[1] in self.config_facts:
+                return self.config_facts[parts[1]]
+            name = self.package.full_name(fn.module, fn, e)
+            if name and name.startswith(_DTYPE_NAMESPACES):
+                d = parse_dtype(name)
+                return frozenset({d}) if d else None
+            return None
+        if isinstance(e, ast.Name):
+            got = dtenv.get(e.id)
+            if got:
+                return got
+            scope, first = fn, True
+            while scope is not None:
+                if not first:
+                    got = self.dtype_env.get(scope, {}).get(e.id)
+                    if got:
+                        return got
+                pd = self.param_dtypes.get(scope, {}).get(e.id)
+                if pd:
+                    return frozenset(pd)
+                scope, first = scope.parent, False
+            return None
+        if isinstance(e, ast.Call):
+            name = self.package.full_name(fn.module, fn, e.func)
+            if name in ("jax.numpy.dtype", "numpy.dtype") and e.args:
+                return self.dtypes(fn, e.args[0], env, dtenv)
+            return None
+        if isinstance(e, ast.BoolOp):
+            # ``cfg.get("compute_dtype") or "bfloat16"``
+            out: Set[str] = set()
+            for v in e.values:
+                sub = self.dtypes(fn, v, env, dtenv)
+                if sub:
+                    out |= sub
+            return frozenset(out) if out else None
+        if isinstance(e, ast.IfExp):
+            a = self.dtypes(fn, e.body, env, dtenv)
+            b = self.dtypes(fn, e.orelse, env, dtenv)
+            if a and b:
+                return a | b
+            return a or b
+        return None
+
+    def single_dtype(self, fn, e, env=None, dtenv=None) -> Optional[str]:
+        dset = self.dtypes(fn, e, env, dtenv)
+        if dset and len(dset) == 1:
+            return next(iter(dset))
+        return None
+
+    # -- array-fact evaluation ----------------------------------------
+
+    def fact(self, fn: FunctionInfo, e, env=None,
+             dtenv=None) -> Optional[DtypeFact]:
+        """Best-effort dtype fact for an array-valued expression."""
+        if e is None:
+            return None
+        if env is None:
+            env = self.env.get(fn, {})
+        if dtenv is None:
+            dtenv = self.dtype_env.get(fn, {})
+        if isinstance(e, ast.Constant):
+            v = e.value
+            if isinstance(v, bool):
+                return DtypeFact("bool", weak=True)
+            if isinstance(v, int):
+                return DtypeFact("int", weak=True)
+            if isinstance(v, float):
+                return DtypeFact("float32", weak=True)
+            return None
+        if isinstance(e, ast.Name):
+            got = env.get(e.id)
+            if got is not None:
+                return got
+            scope, first = fn, True
+            while scope is not None:
+                if not first:
+                    got = self.env.get(scope, {}).get(e.id)
+                    if got is not None:
+                        return got
+                pf = self.param_facts.get(scope, {}).get(e.id)
+                if isinstance(pf, DtypeFact):
+                    return pf
+                scope, first = scope.parent, False
+            return None
+        if isinstance(e, ast.Subscript):
+            return self.fact(fn, e.value, env, dtenv)
+        if isinstance(e, ast.UnaryOp):
+            if isinstance(e.op, ast.Not):
+                return DtypeFact("bool")
+            return self.fact(fn, e.operand, env, dtenv)
+        if isinstance(e, ast.BinOp):
+            out = promote(self.fact(fn, e.left, env, dtenv),
+                          self.fact(fn, e.right, env, dtenv))
+            if out is not None and isinstance(e.op, ast.Div) \
+                    and out.dtype in ("int", "bool"):
+                return DtypeFact("float32", weak=out.weak)
+            return out
+        if isinstance(e, ast.Compare):
+            return DtypeFact("bool")
+        if isinstance(e, ast.IfExp):
+            a = self.fact(fn, e.body, env, dtenv)
+            b = self.fact(fn, e.orelse, env, dtenv)
+            return a if a == b else None
+        if isinstance(e, ast.Call):
+            return self._call_fact(fn, e, env, dtenv)
+        return None
+
+    def _call_fact(self, fn, call: ast.Call, env, dtenv):
+        # explicit dtype= / preferred_element_type= wins
+        for kw in call.keywords:
+            if kw.arg in DTYPE_KWARGS:
+                d = self.single_dtype(fn, kw.value, env, dtenv)
+                return DtypeFact(d) if d else None
+        if isinstance(call.func, ast.Attribute):
+            if call.func.attr == "astype" and call.args:
+                d = self.single_dtype(fn, call.args[0], env, dtenv)
+                return DtypeFact(d) if d else None
+            if call.func.attr in _PASSTHROUGH_METHODS:
+                return self.fact(fn, call.func.value, env, dtenv)
+        name = self.package.full_name(fn.module, fn, call.func)
+        if name:
+            if name in _ASARRAY_FNS:
+                if len(call.args) >= 2:
+                    d = self.single_dtype(fn, call.args[1], env, dtenv)
+                    return DtypeFact(d) if d else None
+                if call.args:
+                    inner = self.fact(fn, call.args[0], env, dtenv)
+                    if inner is not None and inner.weak:
+                        # the concretized-weak marker: a committed
+                        # array that WILL drag bf16 peers up
+                        return DtypeFact(inner.dtype, from_weak=True)
+                    if inner is not None:
+                        return DtypeFact(inner.dtype, False,
+                                         inner.from_weak)
+                return None
+            if name.startswith(_DTYPE_NAMESPACES):
+                d = parse_dtype(name)
+                if d is not None:  # np.float32(0.5): concrete scalar
+                    return DtypeFact(d)
+            if name in _F32_DEFAULT_PRODUCERS:
+                return DtypeFact("float32")
+            if name in _NON_PASSTHROUGH:
+                if name.startswith(("jax.numpy.is", "jax.numpy.logical",
+                                    "jax.numpy.equal",
+                                    "jax.numpy.not_equal",
+                                    "jax.numpy.less",
+                                    "jax.numpy.greater",
+                                    "jax.numpy.allclose",
+                                    "jax.numpy.array_equal")):
+                    return DtypeFact("bool")
+                return None
+            if name.startswith(("jax.numpy.", "jax.lax.", "jax.nn.")):
+                # generic elementwise/reduction passthrough: only when
+                # EVERY positional arg has a known fact
+                if not call.args:
+                    return None
+                facts = [self.fact(fn, a, env, dtenv)
+                         for a in call.args]
+                out = None
+                for i, f in enumerate(facts):
+                    if f is None:
+                        return None
+                    out = f if i == 0 else promote(out, f)
+                return out
+        res = self.package.resolve_callee(fn.module, fn, call.func)
+        if res is not None and res[0] == "fn":
+            return self.returns.get(res[1])
+        return None
+
+    # -- compute reachability -----------------------------------------
+
+    def _fn_value(self, mod: ModuleInfo, scope, expr) \
+            -> Optional[FunctionInfo]:
+        if isinstance(expr, ast.Lambda):
+            return mod.by_node.get(expr)
+        if isinstance(expr, (ast.Name, ast.Attribute)):
+            res = self.package.resolve_callee(mod, scope, expr)
+            if res is not None and res[0] == "fn":
+                return res[1]
+        return None
+
+    def _build_compute_set(self):
+        work = deque()
+
+        def seed(fn):
+            if fn is not None and fn not in self.compute_fns:
+                self.compute_fns.add(fn)
+                work.append(fn)
+
+        for fn in self.package.all_functions():
+            if fn.jit_reachable:
+                seed(fn)
+        for mod in self.package.modules.values():
+            for scope, call in _walk_calls(mod):
+                name = self.package.full_name(mod, scope, call.func)
+                if name in _COMPUTE_WRAPPERS:
+                    for arg in call.args:
+                        seed(self._fn_value(mod, scope, arg))
+        guard = 0
+        while work and guard < 10000:
+            guard += 1
+            fn = work.popleft()
+            mod = fn.module
+            for node in _own_nodes(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                res = self.package.resolve_callee(mod, fn, node.func)
+                if res is not None and res[0] == "fn":
+                    seed(res[1])
+                for arg in list(node.args) \
+                        + [kw.value for kw in node.keywords]:
+                    seed(self._fn_value(mod, fn, arg))
+
+    def call_dtype_kwarg(self, fn, call: ast.Call) \
+            -> Optional[FrozenSet[str]]:
+        """The dtype named by a ``dtype=``/``preferred_element_type=``
+        kwarg on this call, if any resolves."""
+        for kw in call.keywords:
+            if kw.arg in DTYPE_KWARGS:
+                return self.dtypes(fn, kw.value)
+        return None
+
+
+def analyze_num(package: Package) -> NumAnalysis:
+    """Build (once) and cache the dtype analysis for a package."""
+    an = getattr(package, "_numlint_analysis", None)
+    if an is None:
+        an = NumAnalysis(package)
+        package._numlint_analysis = an
+    return an

@@ -1,0 +1,550 @@
+"""numlint's rule registry: six dtype/precision rules for the hot path.
+
+Same shape as :mod:`.rules` / :mod:`.shardrules` / :mod:`.commrules` /
+:mod:`.racerules` — each rule is ``(Package, ModuleInfo) ->
+Iterable[Finding]`` under a stable kebab-case id (what suppression
+comments name), registered in ``NUM_RULES`` and consuming the dtype
+lattice of :mod:`.numlint`.  None of them import jax.
+
+The rules, and the numerics failure each one prevents:
+
+  ``implicit-upcast``      a bf16 operand mixed with a concrete fp32
+                           array (or ``np.float32`` constant) inside
+                           jit-reachable compute -> XLA promotes the
+                           whole expression to fp32 and the MXU runs
+                           at half rate — the MFU killer.
+  ``weak-type-promotion``  a Python scalar needlessly concretized
+                           (``jnp.asarray(0.5)`` with no ``dtype=``)
+                           becomes a committed fp32 array whose
+                           promotion drags bf16 peers up; written as
+                           a bare ``0.5`` the weak scalar would have
+                           followed the bf16 operand for free.
+  ``lowp-accum``           sum/mean/matmul/einsum/conv accumulating
+                           in bf16 with no ``preferred_element_type``
+                           / fp32 accumulation -> long reductions
+                           lose low bits and the loss drifts — the
+                           silent-correctness hazard.
+  ``unguarded-cast``       a lossy downcast (uint8/int8, or
+                           fp32->bf16) escaping to a serialization /
+                           shm / IPC boundary with no round-trip
+                           check — ``staging.py``'s uint8 round-trip
+                           assert is the guarded idiom this rule
+                           wants everywhere.
+  ``dtype-split-brain``    a function returning a pytree that mixes
+                           master-fp32 and compute-bf16 leaves ->
+                           downstream consumers see a per-leaf dtype
+                           lottery and the runtime NumericsGuard
+                           counts contract breaks.
+  ``nonfinite-risk``       log/exp/div/sqrt in jit-reachable loss
+                           code on unclamped inputs -> one empty mask
+                           or saturated ratio turns the loss into
+                           NaN/Inf and poisons every parameter in a
+                           single step.  eps-added denominators,
+                           ``jnp.clip``/``maximum`` guards and
+                           ``log_softmax`` results stay quiet.
+
+Intentional fp32 islands (Adam moments, V-Trace recursions) suppress
+per line with ``# jaxlint: disable=<rule> -- reason``.
+"""
+
+import ast
+from typing import Dict, Optional, Set
+
+from .astutil import ModuleInfo, Package
+from .numlint import (
+    DTYPE_KWARGS, DtypeFact, HIGH_PRECISION, LOSSY_TARGETS,
+    LOW_PRECISION, _own_nodes, analyze_num,
+)
+from .rules import Finding, Rule
+
+NUM_RULES: Dict[str, Rule] = {}
+
+
+def num_rule(rule_id: str, summary: str):
+    def deco(fn):
+        NUM_RULES[rule_id] = Rule(rule_id, summary, fn.__doc__ or "",
+                                  fn)
+        return fn
+    return deco
+
+
+def _loc(node):
+    return node.lineno, getattr(node, "col_offset", 0)
+
+
+def _is_low(f: Optional[DtypeFact]) -> bool:
+    return f is not None and f.dtype in LOW_PRECISION and not f.weak
+
+
+def _is_high_concrete(f: Optional[DtypeFact]) -> bool:
+    return (f is not None and f.dtype in HIGH_PRECISION
+            and not f.weak and not f.from_weak)
+
+
+def _compute_functions(an, mod: ModuleInfo):
+    """This module's functions that run inside compiled compute
+    (jit-reachable per astutil, plus grad/scan/vmap closures and
+    their callees — see :attr:`NumAnalysis.compute_fns`)."""
+    for fn in mod.functions:
+        if fn in an.compute_fns:
+            yield fn
+
+
+# ---------------------------------------------------------------------
+# precision mixing
+# ---------------------------------------------------------------------
+
+@num_rule("implicit-upcast",
+          "bf16 operand mixed with a concrete fp32 array in "
+          "jit-reachable compute")
+def check_implicit_upcast(package: Package, mod: ModuleInfo):
+    """A binary op inside jit-reachable code mixes a low-precision
+    (bf16/fp16) operand with a *concrete* fp32/fp64 one — an fp32
+    array, an ``np.float32(...)`` constant, a ``jnp.zeros`` default.
+    JAX promotes the result (and usually the rest of the expression)
+    to the high dtype, so the compute the mixed-precision regime put
+    in bf16 silently runs at fp32 MXU rate.  Cast the high operand
+    down at the boundary, or keep scalars weak (a bare Python ``0.5``
+    follows the bf16 operand and never fires here).  Deliberate fp32
+    islands (Adam moments, V-Trace recursion) suppress with a
+    reason."""
+    an = analyze_num(package)
+    for fn in _compute_functions(an, mod):
+        for node in _own_nodes(fn):
+            if not isinstance(node, ast.BinOp):
+                continue
+            left = an.fact(fn, node.left)
+            right = an.fact(fn, node.right)
+            for lo, hi in ((left, right), (right, left)):
+                if _is_low(lo) and _is_high_concrete(hi):
+                    line, col = _loc(node)
+                    yield Finding(
+                        "implicit-upcast", mod.path, line, col,
+                        f"{lo.dtype} operand mixed with a concrete "
+                        f"{hi.dtype} operand — the result promotes to "
+                        f"{hi.dtype} inside jit-reachable compute; "
+                        f"cast the {hi.dtype} side down (or keep it a "
+                        f"weak Python scalar)")
+                    break
+
+
+@num_rule("weak-type-promotion",
+          "needlessly concretized Python scalar drags bf16 compute "
+          "up to fp32")
+def check_weak_type_promotion(package: Package, mod: ModuleInfo):
+    """A Python scalar was wrapped in ``jnp.asarray``/``jnp.array``
+    with no ``dtype=`` and then mixed with bf16 operands.  The wrap
+    commits the scalar to concrete fp32, so JAX's weak-type escape
+    hatch no longer applies and the bf16 side promotes.  Drop the
+    wrap (weak scalars follow their peers) or pass the compute dtype
+    explicitly."""
+    an = analyze_num(package)
+    for fn in _compute_functions(an, mod):
+        for node in _own_nodes(fn):
+            if not isinstance(node, ast.BinOp):
+                continue
+            left = an.fact(fn, node.left)
+            right = an.fact(fn, node.right)
+            for lo, wk in ((left, right), (right, left)):
+                if _is_low(lo) and wk is not None and wk.from_weak \
+                        and wk.dtype in HIGH_PRECISION:
+                    line, col = _loc(node)
+                    yield Finding(
+                        "weak-type-promotion", mod.path, line, col,
+                        f"a Python scalar concretized to {wk.dtype} "
+                        f"(jnp.asarray with no dtype=) promotes this "
+                        f"{lo.dtype} operand — keep the scalar weak "
+                        f"or pass dtype= at the wrap")
+                    break
+
+
+# ---------------------------------------------------------------------
+# accumulation precision
+# ---------------------------------------------------------------------
+
+_ACCUM_FNS = frozenset({
+    "jax.numpy.sum", "jax.numpy.mean", "jax.numpy.matmul",
+    "jax.numpy.dot", "jax.numpy.einsum", "jax.numpy.tensordot",
+    "jax.numpy.cumsum", "jax.numpy.var", "jax.numpy.std",
+    "jax.lax.dot_general", "jax.lax.conv_general_dilated",
+})
+_ACCUM_METHODS = frozenset({"sum", "mean", "dot", "cumsum", "var",
+                            "std"})
+
+
+@num_rule("lowp-accum",
+          "long reduction/contraction accumulates in bf16 without "
+          "preferred_element_type")
+def check_lowp_accum(package: Package, mod: ModuleInfo):
+    """A reduction or contraction (sum/mean/matmul/einsum/conv) over
+    low-precision operands carries no ``preferred_element_type=`` /
+    ``dtype=`` — the accumulator inherits bf16 and a long sum loses
+    its low bits one rounding at a time.  Ask for fp32 accumulation
+    explicitly; the MXU does it for free."""
+    an = analyze_num(package)
+    for fn in _compute_functions(an, mod):
+        for node in _own_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if any(kw.arg in DTYPE_KWARGS for kw in node.keywords):
+                continue
+            hit = None
+            name = package.full_name(mod, fn, node.func)
+            if name in _ACCUM_FNS:
+                for arg in node.args:
+                    f = an.fact(fn, arg)
+                    if _is_low(f):
+                        hit = (name.rsplit(".", 1)[-1], f)
+                        break
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _ACCUM_METHODS:
+                f = an.fact(fn, node.func.value)
+                if _is_low(f):
+                    hit = (node.func.attr, f)
+            if hit is not None:
+                op, f = hit
+                line, col = _loc(node)
+                yield Finding(
+                    "lowp-accum", mod.path, line, col,
+                    f"`{op}` accumulates {f.dtype} operands in "
+                    f"{f.dtype} — pass "
+                    f"preferred_element_type=jnp.float32 (or dtype=) "
+                    f"so the long reduction keeps its low bits")
+
+
+# ---------------------------------------------------------------------
+# lossy casts at boundaries
+# ---------------------------------------------------------------------
+
+_SINK_METHODS = frozenset({
+    "send", "send_bytes", "put", "put_nowait", "write", "dump",
+    "dumps", "save", "tobytes",
+})
+_SINK_FNS = frozenset({
+    "pickle.dumps", "pickle.dump", "numpy.save", "numpy.savez",
+    "numpy.savez_compressed",
+})
+_ROUNDTRIP_FNS = frozenset({
+    "numpy.array_equal", "numpy.allclose",
+    "numpy.testing.assert_allclose", "numpy.testing.assert_array_equal",
+    "jax.numpy.array_equal", "jax.numpy.allclose", "jax.numpy.isclose",
+})
+
+
+def _names_in(node) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+@num_rule("unguarded-cast",
+          "lossy downcast escapes to a serialization boundary with "
+          "no round-trip check")
+def check_unguarded_cast(package: Package, mod: ModuleInfo):
+    """A lossy cast (to uint8/int8, or a definite fp32->bf16 drop)
+    whose result leaves the process — sent over a pipe/queue, written
+    to shm, pickled, saved — with no round-trip assert or tolerance
+    gate anywhere in the function.  Quantized wire formats are fine
+    *when audited*: the ``staging.py`` uint8 path round-trips the
+    first frame through an assert, and that guard is exactly what
+    quiets this rule."""
+    an = analyze_num(package)
+    for fn in mod.functions:
+        nodes = _own_nodes(fn)
+        # lossy cast sites: (call node, bound name or None, src name)
+        casts = []
+        for node in nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            target = src = None
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "astype" and node.args:
+                target = an.single_dtype(fn, node.args[0])
+                src = node.func.value
+            else:
+                name = package.full_name(mod, fn, node.func)
+                if name and name.rsplit(".", 1)[-1] in ("asarray",
+                                                        "array") \
+                        and node.args:
+                    for kw in node.keywords:
+                        if kw.arg == "dtype":
+                            target = an.single_dtype(fn, kw.value)
+                    src = node.args[0]
+            if target is None:
+                continue
+            src_fact = an.fact(fn, src)
+            lossy = (target in LOSSY_TARGETS
+                     and (src_fact is None
+                          or src_fact.dtype != target)) \
+                or (target in LOW_PRECISION
+                    and _is_high_concrete(src_fact))
+            if lossy:
+                casts.append((node, target, src))
+        if not casts:
+            continue
+        # single-target bindings: name -> value node
+        bound: Dict[ast.AST, str] = {}
+        for node in nodes:
+            if isinstance(node, ast.Assign) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                bound[node.value] = node.targets[0].id
+        for call, target, src in casts:
+            watch = {bound[call]} if call in bound else set()
+            if isinstance(src, ast.Name):
+                src_name = src.id
+            else:
+                src_name = None
+            # does the cast escape?
+            escapes = False
+            for node in nodes:
+                if isinstance(node, ast.Call):
+                    is_sink = (isinstance(node.func, ast.Attribute)
+                               and node.func.attr in _SINK_METHODS) \
+                        or package.full_name(mod, fn,
+                                             node.func) in _SINK_FNS
+                    if is_sink and any(
+                            a is call or (_names_in(a) & watch)
+                            for a in node.args):
+                        escapes = True
+                elif isinstance(node, ast.Return) and watch \
+                        and node.value is not None \
+                        and (_names_in(node.value) & watch):
+                    escapes = True
+                elif isinstance(node, ast.Assign) and watch:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Subscript) \
+                                and (_names_in(node.value) & watch):
+                            escapes = True
+            if not escapes:
+                continue
+            # round-trip guard anywhere in the function?
+            guard_names = set(watch)
+            if src_name:
+                guard_names.add(src_name)
+            guarded = False
+            for node in nodes:
+                if isinstance(node, ast.Assert) \
+                        and (_names_in(node.test) & guard_names):
+                    guarded = True
+                elif isinstance(node, ast.Call) \
+                        and package.full_name(
+                            mod, fn, node.func) in _ROUNDTRIP_FNS \
+                        and any(_names_in(a) & guard_names
+                                for a in node.args):
+                    guarded = True
+            if guarded:
+                continue
+            line, col = _loc(call)
+            yield Finding(
+                "unguarded-cast", mod.path, line, col,
+                f"cast to {target} escapes to a serialization "
+                f"boundary with no round-trip check — assert the "
+                f"decode matches (staging.py's uint8 idiom) or gate "
+                f"it behind a tolerance")
+
+
+# ---------------------------------------------------------------------
+# return contracts
+# ---------------------------------------------------------------------
+
+@num_rule("dtype-split-brain",
+          "returned pytree mixes bf16 and fp32 leaves against one "
+          "contract")
+def check_dtype_split_brain(package: Package, mod: ModuleInfo):
+    """A function returns a dict/tuple/list literal whose leaves mix
+    definite low-precision and definite high-precision dtypes.  Every
+    consumer now inherits a per-leaf dtype lottery — the static twin
+    of what the runtime NumericsGuard counts as a contract break.
+    Cast the leaves to one declared dtype at the return, or split the
+    master-fp32 and compute-bf16 trees into separate returns."""
+    an = analyze_num(package)
+    for fn in mod.functions:
+        for node in _own_nodes(fn):
+            if not isinstance(node, ast.Return) or node.value is None:
+                continue
+            val = node.value
+            if isinstance(val, ast.Dict):
+                leaves = [v for v in val.values]
+            elif isinstance(val, (ast.Tuple, ast.List)):
+                leaves = list(val.elts)
+            else:
+                continue
+            lows, highs = [], []
+            for leaf in leaves:
+                f = an.fact(fn, leaf)
+                if _is_low(f):
+                    lows.append(f.dtype)
+                elif _is_high_concrete(f):
+                    highs.append(f.dtype)
+            if lows and highs:
+                line, col = _loc(node)
+                yield Finding(
+                    "dtype-split-brain", mod.path, line, col,
+                    f"returned pytree mixes {sorted(set(lows))} and "
+                    f"{sorted(set(highs))} leaves — cast to one "
+                    f"declared dtype or split the trees")
+
+
+# ---------------------------------------------------------------------
+# nonfinite producers
+# ---------------------------------------------------------------------
+
+_LOG_LIKE = frozenset({
+    "jax.numpy.log", "jax.numpy.log2", "jax.numpy.log10",
+    "jax.lax.log",
+})
+_EXP_LIKE = frozenset({"jax.numpy.exp", "jax.lax.exp"})
+_SQRT_LIKE = frozenset({
+    "jax.numpy.sqrt", "jax.lax.sqrt", "jax.lax.rsqrt",
+})
+_CLAMP_ALL = ("clip",)
+_CLAMP_LOW = ("maximum", "abs", "absolute", "square", "exp",
+              "softmax", "sigmoid")  # guards log/sqrt/div lower bound
+_CLAMP_HIGH = ("minimum", "log_softmax", "log_sigmoid",
+               "tanh")               # guards exp upper bound
+_REDUCTIONS = frozenset({"sum", "mean"})
+
+
+def _positive_const(node) -> bool:
+    return (isinstance(node, ast.Constant)
+            and isinstance(node.value, (int, float))
+            and not isinstance(node.value, bool)
+            and node.value > 0)
+
+
+class _NonfiniteScan:
+    """Per-function guard reasoning for log/exp/sqrt/div inputs."""
+
+    def __init__(self, package: Package, mod: ModuleInfo, fn):
+        self.pkg = package
+        self.mod = mod
+        self.fn = fn
+        # single-assignment bindings, for chasing names into guards
+        self.bindings: Dict[str, ast.AST] = {}
+        seen: Set[str] = set()
+        for node in _own_nodes(fn):
+            if isinstance(node, ast.Assign) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                name = node.targets[0].id
+                if name in seen:
+                    self.bindings.pop(name, None)
+                else:
+                    seen.add(name)
+                    self.bindings[name] = node.value
+
+    def _callee_tail(self, call: ast.Call) -> Optional[str]:
+        name = self.pkg.full_name(self.mod, self.fn, call.func)
+        if name:
+            return name.rsplit(".", 1)[-1]
+        if isinstance(call.func, ast.Attribute):
+            return call.func.attr
+        return None
+
+    def guarded(self, expr, kind: str, depth: int = 3) -> bool:
+        """Is this input safe for ``kind`` in {log, exp, sqrt, div}?"""
+        if depth <= 0:
+            return False
+        if isinstance(expr, ast.Constant):
+            return True
+        if isinstance(expr, ast.Name):
+            chased = self.bindings.get(expr.id)
+            if chased is not None:
+                return self.guarded(chased, kind, depth - 1)
+            return False
+        if isinstance(expr, ast.Subscript):
+            # shape[i]-style static denominators
+            base = expr.value
+            if isinstance(base, ast.Attribute) \
+                    and base.attr in ("shape",):
+                return True
+            return self.guarded(base, kind, depth - 1)
+        if isinstance(expr, ast.Attribute):
+            return expr.attr in ("shape", "size", "ndim")
+        if isinstance(expr, ast.Call):
+            tail = self._callee_tail(expr)
+            if tail in _CLAMP_ALL:
+                return True
+            if kind in ("log", "sqrt", "div") and tail in _CLAMP_LOW:
+                return True
+            if kind == "exp" and tail in _CLAMP_HIGH:
+                return True
+            return False
+        if isinstance(expr, ast.BinOp):
+            if isinstance(expr.op, ast.Add) and kind != "exp" \
+                    and (_positive_const(expr.left)
+                         or _positive_const(expr.right)):
+                return True  # the `+ eps` idiom
+            if isinstance(expr.op, (ast.Mult, ast.Div)):
+                return self.guarded(expr.left, kind, depth - 1) \
+                    and self.guarded(expr.right, kind, depth - 1)
+            return False
+        if isinstance(expr, ast.UnaryOp):
+            return self.guarded(expr.operand, kind, depth - 1)
+        return False
+
+    def risky_reduction_denom(self, expr, depth: int = 3) -> bool:
+        """Is this denominator an unguarded mask-count reduction
+        (``tmasks.sum()`` with no ``+ eps``)?"""
+        if depth <= 0:
+            return False
+        if isinstance(expr, ast.Name):
+            chased = self.bindings.get(expr.id)
+            return chased is not None \
+                and self.risky_reduction_denom(chased, depth - 1)
+        if isinstance(expr, ast.Call):
+            tail = self._callee_tail(expr)
+            return tail in _REDUCTIONS \
+                and not any(kw.arg in DTYPE_KWARGS + ("where",)
+                            for kw in expr.keywords)
+        return False
+
+
+@num_rule("nonfinite-risk",
+          "log/exp/div/sqrt on unclamped inputs in jit-reachable "
+          "loss code")
+def check_nonfinite_risk(package: Package, mod: ModuleInfo):
+    """A nonfinite producer in jit-reachable code: ``jnp.log`` /
+    ``jnp.sqrt`` on an input with no clamp/eps lower bound,
+    ``jnp.exp`` on an unbounded exponent (importance ratios!), or a
+    division whose denominator is a bare mask-count reduction
+    (``x / tmasks.sum()`` — one empty mask and the loss is NaN).
+    Clamp at the producer: ``jnp.log(jnp.clip(p, 1e-16, 1.0))``,
+    ``jnp.exp(jnp.clip(logr, -20, 20))``, ``/ (count + 1e-8)``.
+    The analysis chases single-assignment names up to three hops, so
+    naming the clamped value first costs nothing."""
+    an = analyze_num(package)
+    for fn in _compute_functions(an, mod):
+        scan = _NonfiniteScan(package, mod, fn)
+        for node in _own_nodes(fn):
+            if isinstance(node, ast.Call):
+                name = package.full_name(mod, fn, node.func)
+                kind = None
+                if name in _LOG_LIKE:
+                    kind = "log"
+                elif name in _EXP_LIKE:
+                    kind = "exp"
+                elif name in _SQRT_LIKE:
+                    kind = "sqrt"
+                if kind is None or not node.args:
+                    continue
+                if scan.guarded(node.args[0], kind):
+                    continue
+                line, col = _loc(node)
+                op = (name or kind).rsplit(".", 1)[-1]
+                yield Finding(
+                    "nonfinite-risk", mod.path, line, col,
+                    f"`{op}` on an unclamped input — clamp at the "
+                    f"producer (jnp.clip / maximum / + eps) so one "
+                    f"bad step cannot poison the parameters")
+            elif isinstance(node, ast.BinOp) \
+                    and isinstance(node.op, ast.Div):
+                if not scan.risky_reduction_denom(node.right):
+                    continue
+                if scan.guarded(node.right, "div"):
+                    continue
+                line, col = _loc(node)
+                yield Finding(
+                    "nonfinite-risk", mod.path, line, col,
+                    "division by a bare mask-count reduction — an "
+                    "empty mask divides by zero; add the `+ eps` "
+                    "the other denominators here carry")

@@ -171,6 +171,15 @@ class TrainConfig:
     # resharding-copy budget asserted by the guard at the offending
     # call; 0 = count and report, but never raise
     max_resharding_copies: int = 0
+    # arm a NumericsGuard around the jitted update step: latches the
+    # per-leaf dtype treedef at first call and reports per-epoch
+    # `numerics_contract_breaks` / `weak_upcasts`, plus
+    # `nonfinite_steps` from the step's in-graph loss/grad-norm
+    # finiteness flag — the runtime twin of numlint's rules
+    numerics_guard: bool = True
+    # nonfinite-step budget asserted at the epoch boundary
+    # (NumericsError past it); 0 = count and report, but never raise
+    max_nonfinite_steps: int = 0
     # -- resilience (handyrl_tpu.resilience) --
     # seconds of control-plane silence after which a gather sends an
     # explicit heartbeat (liveness otherwise piggybacks on its normal
@@ -321,6 +330,7 @@ class TrainConfig:
                     "checkpoint_keep_every", "device_replay_mb",
                     "device_replay_episodes", "updates_per_epoch",
                     "max_update_compiles", "max_resharding_copies",
+                    "max_nonfinite_steps",
                     "heartbeat_interval", "max_respawns",
                     "max_frame_bytes", "status_port",
                     "target_update_interval", "max_policy_lag",

@@ -46,6 +46,7 @@ from . import telemetry
 from .analysis.guards import (
     HostTransferGuard,
     LockOrderGuard,
+    NumericsGuard,
     RetraceGuard,
     ShardingContractGuard,
     StallWatchdog,
@@ -454,6 +455,17 @@ class Trainer:
                 max_copies=self.args.get("max_resharding_copies", 0),
                 name="update_step")
             if self.args.get("sharding_contract_guard", True) else None)
+        # numerics contract: the update step's arguments must keep the
+        # per-leaf dtype/weak-type of their first call, and the step's
+        # in-graph loss/grad-norm finiteness flag must stay 0 — the
+        # runtime twin of numlint (analysis/numlint.py), reported per
+        # epoch as numerics_contract_breaks / nonfinite_steps /
+        # weak_upcasts
+        self.num_guard = (
+            NumericsGuard(
+                max_nonfinite=self.args.get("max_nonfinite_steps", 0),
+                name="update_step")
+            if self.args.get("numerics_guard", True) else None)
 
         # off-policy robustness (IMPACT): the update step threads a
         # target network whose params start as an exact copy of the
@@ -469,7 +481,8 @@ class Trainer:
             if self.impact:
                 self.target_params = jax.tree.map(np.asarray, self.params)
             self.update_step = self.retrace_guard.wrap(
-                self._wrap_sharding(self._build_update_step()))
+                self._wrap_sharding(self._wrap_numerics(
+                    self._build_update_step())))
             self._maybe_restore_train_state()
             if self.multihost:
                 self._sync_initial_state()
@@ -501,13 +514,14 @@ class Trainer:
             # instead assembles global batches from the local rings
             # and runs the global update_step)
             self._replay_step = self.retrace_guard.wrap(
-                self._wrap_sharding(make_replay_update_step(
+                self._wrap_sharding(self._wrap_numerics(
+                    make_replay_update_step(
                     self.device_replay, self.model, self.loss_cfg,
                     self.optimizer, self.compute_dtype,
                     batch_size=self.args["batch_size"],
                     mesh=self.train_mesh, params=self.params,
                     fsdp=self.train_fsdp,
-                    seed=self.args.get("seed", 0))))
+                    seed=self.args.get("seed", 0)))))
         # the host batcher farm exists only when the device-resident
         # path is off: skipping it frees host cores for actors
         self.batcher = None
@@ -570,7 +584,8 @@ class Trainer:
                   "back to the IMPALA worker path")
             return
         self._anakin_step = self.retrace_guard.wrap(
-            self._wrap_sharding(self.anakin.make_fused_step()))
+            self._wrap_sharding(self._wrap_numerics(
+                self.anakin.make_fused_step())))
         # the carry folds the resumed step count into its PRNG stream,
         # so a restart continues on fresh data deterministically
         self.anakin_carry = self.anakin.init_carry(self.steps)
@@ -584,6 +599,11 @@ class Trainer:
         if self.shard_guard is None:
             return step
         return self.shard_guard.wrap(step)
+
+    def _wrap_numerics(self, step):
+        if self.num_guard is None:
+            return step
+        return self.num_guard.wrap(step)
 
     def _maybe_device_replay(self):
         """Build the HBM-resident replay (staging.DeviceReplay) when
@@ -1207,6 +1227,14 @@ class Trainer:
             # feed stages batches onto the batch sharding)
             self.last_metrics["resharding_copies"] = \
                 self.shard_guard.snapshot()
+        if self.num_guard is not None:
+            # the step's in-graph finiteness flag rode the metrics dict
+            # to the ONE device_get above — counting it here costs no
+            # extra host syncs.  note_step raises NumericsError when a
+            # max_nonfinite_steps budget is armed and exceeded
+            for m in metric_acc:
+                self.num_guard.note_step(m.get("nonfinite", 0.0))
+            self.last_metrics.update(self.num_guard.snapshot())
         if self.device_replay is not None:
             self.last_metrics["replay_episodes"] = \
                 self.device_replay.episodes_seen
@@ -1755,6 +1783,9 @@ class Learner:
         if self.wal is not None:
             snap["wal"] = self.wal.stats()
         trainer = getattr(self, "trainer", None)
+        num_guard = getattr(trainer, "num_guard", None)
+        if num_guard is not None:
+            snap["numerics"] = num_guard.stats()
         if trainer is not None and \
                 getattr(trainer, "anakin", None) is not None:
             snap["anakin"] = {

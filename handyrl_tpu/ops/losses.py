@@ -300,7 +300,11 @@ def compute_loss(apply_fn: Callable, params, batch, hidden, cfg: LossConfig,
         log_rhos = log_selected_g - log_selected_b
     else:
         log_rhos = lax.stop_gradient(log_selected_t) - log_selected_b
-    rhos = jnp.exp(log_rhos)
+    # exp of an unbounded log-ratio overflows to inf on the first
+    # badly-stale batch; +/-20 is far beyond the useful range (the
+    # ratios are clipped to rho_clip/c_clip right below) but keeps
+    # the op finite
+    rhos = jnp.exp(jnp.clip(log_rhos, -20.0, 20.0))
     clipped_rhos = jnp.clip(rhos, 0.0, cfg.rho_clip)
     cs = jnp.clip(rhos, 0.0, cfg.c_clip)
 
@@ -353,7 +357,11 @@ def compute_loss(apply_fn: Callable, params, batch, hidden, cfg: LossConfig,
         # replaced by the current/target ratio under a two-sided PPO
         # clip — maximize min(r*A, clip(r, 1-eps, 1+eps)*A)
         adv = sum(advantages.values())
-        ratio = jnp.exp(log_selected_t - log_selected_g)
+        # same finite-exp discipline as the rhos above: the surrogate
+        # clip bounds the USED ratio to 1 +/- eps, so clamping the
+        # exponent changes nothing numerically useful
+        ratio = jnp.exp(jnp.clip(log_selected_t - log_selected_g,
+                                 -20.0, 20.0))
         eps = cfg.surrogate_clip
         surrogate = jnp.minimum(
             ratio * adv, jnp.clip(ratio, 1.0 - eps, 1.0 + eps) * adv)

@@ -137,8 +137,14 @@ def make_update_core(model, cfg: LossConfig,
         )
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
-        metrics = {**losses, "dcnt": dcnt,
-                   "grad_norm": optax.global_norm(grads)}
+        gnorm = optax.global_norm(grads)
+        # in-graph nonfinite flag: 1.0 when the loss or the gradient
+        # global norm went NaN/Inf this step.  It rides the per-step
+        # metrics dict to the ONE per-epoch device_get, where the
+        # learner's NumericsGuard counts it — no extra host syncs
+        finite = jnp.isfinite(losses["total"]) & jnp.isfinite(gnorm)
+        metrics = {**losses, "dcnt": dcnt, "grad_norm": gnorm,
+                   "nonfinite": 1.0 - finite.astype(jnp.float32)}
         return params, opt_state, metrics
 
     if not impact:

@@ -159,7 +159,10 @@ def plot(epochs, out_prefix):
     guard_keys = [k for k in ("retrace_count", "host_transfers",
                               "resharding_copies", "stall_events",
                               "lock_contention_sec",
-                              "lock_order_inversions")
+                              "lock_order_inversions",
+                              "nonfinite_steps",
+                              "numerics_contract_breaks",
+                              "weak_upcasts")
                   if any(k in e for e in epochs)]
     if guard_keys:
         fig, ax = plt.subplots(figsize=(8, 5))

@@ -1,0 +1,7 @@
+"""POS: one returned pytree mixes bf16 and fp32 leaves."""
+import jax.numpy as jnp
+
+
+def pack(x):
+    return {"hidden": x.astype(jnp.bfloat16),
+            "value": x.astype(jnp.float32)}

@@ -1,0 +1,8 @@
+"""SUPP: the split is the mixed-precision contract, with a reason."""
+import jax.numpy as jnp
+
+
+def pack(x):
+    # jaxlint: disable=dtype-split-brain -- hidden is bf16 compute, value head is a deliberate fp32 island
+    return {"hidden": x.astype(jnp.bfloat16),
+            "value": x.astype(jnp.float32)}

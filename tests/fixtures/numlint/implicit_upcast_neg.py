@@ -1,0 +1,10 @@
+"""NEG: the scalar is staged at the compute dtype, no promotion."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def forward(x):
+    h = x.astype(jnp.bfloat16)
+    scale = jnp.asarray(0.5, dtype=jnp.bfloat16)
+    return h * scale

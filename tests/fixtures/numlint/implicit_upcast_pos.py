@@ -1,0 +1,11 @@
+"""POS: bf16 activations silently upcast by a concrete fp32 scalar."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def forward(x):
+    h = x.astype(jnp.bfloat16)
+    scale = np.float32(0.5)
+    return h * scale

@@ -1,0 +1,13 @@
+"""SUPP: an intentional fp32 island, suppressed with a reason."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def forward(x):
+    h = x.astype(jnp.bfloat16)
+    scale = np.float32(0.5)
+    # deliberate fp32 island: the final head runs full precision
+    # jaxlint: disable=implicit-upcast -- fp32 head is the mixed-precision boundary
+    return h * scale

@@ -1,0 +1,10 @@
+"""NEG: bf16 inputs, fp32 accumulation via preferred_element_type."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def attention(q, k):
+    qh = q.astype(jnp.bfloat16)
+    kh = k.astype(jnp.bfloat16)
+    return jnp.matmul(qh, kh, preferred_element_type=jnp.float32)

@@ -1,0 +1,10 @@
+"""POS: a bf16 matmul with no accumulator dtype — sums at bf16."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def attention(q, k):
+    qh = q.astype(jnp.bfloat16)
+    kh = k.astype(jnp.bfloat16)
+    return jnp.matmul(qh, kh)

@@ -1,0 +1,11 @@
+"""SUPP: bf16 accumulation accepted for this op, with a reason."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def attention(q, k):
+    qh = q.astype(jnp.bfloat16)
+    kh = k.astype(jnp.bfloat16)
+    # jaxlint: disable=lowp-accum -- contraction dim is 64; bf16 error is below the logit noise floor
+    return jnp.matmul(qh, kh)

@@ -1,0 +1,8 @@
+"""NEG: the probability is clipped away from zero before the log."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def policy_loss(p, adv):
+    return -(jnp.log(jnp.clip(p, 1e-16, 1.0)) * adv).sum()

@@ -1,0 +1,8 @@
+"""POS: log of an unclamped probability in jitted loss code."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def policy_loss(p, adv):
+    return -(jnp.log(p) * adv).sum()

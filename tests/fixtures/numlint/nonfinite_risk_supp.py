@@ -1,0 +1,9 @@
+"""SUPP: the domain is guaranteed upstream, suppressed with a reason."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def policy_loss(p, adv):
+    # jaxlint: disable=nonfinite-risk -- p exits a floored softmax and cannot be exactly zero
+    return -(jnp.log(p) * adv).sum()

@@ -1,0 +1,9 @@
+"""NEG: the uint8 wire cast is guarded by a round-trip assert (the
+staging.py obs_store idiom)."""
+import numpy as np
+
+
+def ship(pipe, frame):
+    q = frame.astype(np.uint8)
+    assert np.array_equal(q.astype(np.float32), frame)
+    pipe.send(q)

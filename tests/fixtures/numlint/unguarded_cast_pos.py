@@ -1,0 +1,7 @@
+"""POS: a lossy uint8 quantization escapes over a pipe unchecked."""
+import numpy as np
+
+
+def ship(pipe, frame):
+    q = frame.astype(np.uint8)
+    pipe.send(q)

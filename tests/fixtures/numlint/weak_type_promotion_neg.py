@@ -1,0 +1,10 @@
+"""NEG: the wrapped scalar is pinned to the compute dtype."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def forward(x):
+    h = x.astype(jnp.bfloat16)
+    step = jnp.asarray(0.1, dtype=jnp.bfloat16)
+    return h * step

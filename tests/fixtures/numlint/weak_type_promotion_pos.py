@@ -1,0 +1,10 @@
+"""POS: a weak python scalar wrapped by asarray drags bf16 to fp32."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def forward(x):
+    h = x.astype(jnp.bfloat16)
+    step = jnp.asarray(0.1)
+    return h * step

@@ -1,0 +1,12 @@
+"""SUPP: the promotion is wanted here, suppressed with a reason."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def forward(x):
+    h = x.astype(jnp.bfloat16)
+    step = jnp.asarray(0.1)
+    # the residual add is the fp32 master-weight path
+    # jaxlint: disable=weak-type-promotion -- promotion to fp32 is the contract here
+    return h * step

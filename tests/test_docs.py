@@ -65,11 +65,12 @@ def test_docs_exist():
 
 def test_static_analysis_doc_covers_every_rule():
     """docs/static_analysis.md documents each lint rule by id — ALL
-    FOUR registries (the suppression comments reference these names,
+    FIVE registries (the suppression comments reference these names,
     so the page is the rule registries' public contract).  Mechanical,
     like the parameters check above: a new rule set cannot land
     undocumented."""
     from handyrl_tpu.analysis.commrules import COMM_RULES
+    from handyrl_tpu.analysis.numrules import NUM_RULES
     from handyrl_tpu.analysis.racerules import RACE_RULES
     from handyrl_tpu.analysis.rules import RULES
     from handyrl_tpu.analysis.shardrules import SHARD_RULES
@@ -79,7 +80,8 @@ def test_static_analysis_doc_covers_every_rule():
         text = f.read()
     missing = [r
                for r in (list(RULES) + list(SHARD_RULES)
-                         + list(COMM_RULES) + list(RACE_RULES))
+                         + list(COMM_RULES) + list(RACE_RULES)
+                         + list(NUM_RULES))
                if f"`{r}`" not in text]
     assert not missing, f"rules undocumented in static_analysis.md: {missing}"
 
@@ -93,6 +95,7 @@ def test_list_rules_covers_every_registry():
 
     from handyrl_tpu.analysis.commrules import COMM_RULES
     from handyrl_tpu.analysis.jaxlint import main
+    from handyrl_tpu.analysis.numrules import NUM_RULES
     from handyrl_tpu.analysis.racerules import RACE_RULES
     from handyrl_tpu.analysis.rules import RULES
     from handyrl_tpu.analysis.shardrules import SHARD_RULES
@@ -101,7 +104,8 @@ def test_list_rules_covers_every_registry():
     with contextlib.redirect_stdout(buf):
         assert main(["--list-rules"]) == 0
     out = buf.getvalue()
-    for registry in (RULES, SHARD_RULES, COMM_RULES, RACE_RULES):
+    for registry in (RULES, SHARD_RULES, COMM_RULES, RACE_RULES,
+                     NUM_RULES):
         for rule_id, rule in registry.items():
             assert f"{rule_id}: {rule.summary}" in out, (
                 f"--list-rules missing {rule_id} (or its summary)")

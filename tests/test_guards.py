@@ -1,8 +1,10 @@
 """Runtime guard suite: RetraceGuard compile accounting (cache-size and
 signature-fallback paths, budget enforcement), HostTransferGuard
-transfer counting (device hits, host passes, budget, restoration), and
+transfer counting (device hits, host passes, budget, restoration),
 ShardingContractGuard resharding accounting (contract capture, copy
-counting, budget, snapshot deltas)."""
+counting, budget, snapshot deltas), and NumericsGuard dtype-contract +
+nonfinite-step accounting (latch, break/upcast split, off-switch,
+budget)."""
 
 import jax
 import jax.numpy as jnp
@@ -12,6 +14,8 @@ import pytest
 from handyrl_tpu.analysis.guards import (
     HostTransferError,
     HostTransferGuard,
+    NumericsError,
+    NumericsGuard,
     RetraceError,
     RetraceGuard,
     ShardingContractError,
@@ -479,3 +483,102 @@ def test_lock_guard_cross_thread_contention_real_clock():
     thread.join(5)
     assert guard.stats()["lock_contention_sec"] >= 0.1
     assert guard.stats()["lock_order_inversions"] == 0
+
+
+# ---------------------------------------------------------------------
+# NumericsGuard
+# ---------------------------------------------------------------------
+
+def test_numerics_guard_stable_dtypes_count_nothing():
+    guard = NumericsGuard(name="step")
+    step = guard.wrap(jax.jit(lambda t: jax.tree.map(
+        lambda a: a + 1, t)))
+    for _ in range(5):
+        step({"w": jnp.ones(4, jnp.float32),
+              "h": jnp.ones(4, jnp.bfloat16)})
+    assert guard.contract_breaks == 0
+    assert guard.weak_upcasts == 0
+
+
+def test_numerics_guard_counts_injected_fp64_leaf():
+    """A leaf arriving at a different concrete dtype than the latched
+    contract is exactly one break per deviating call."""
+    guard = NumericsGuard(name="step")
+    step = guard.wrap(jax.jit(lambda t: jax.tree.map(
+        lambda a: a + 1, t)))
+    step({"w": jnp.ones(4, jnp.float32)})
+    step({"w": np.ones(4, np.float64)})  # the split-brain leaf
+    assert guard.contract_breaks == 1
+    # the contract does NOT re-latch: a persistent flip keeps counting
+    step({"w": np.ones(4, np.float64)})
+    assert guard.contract_breaks == 2
+
+
+def test_numerics_guard_weak_flip_is_an_upcast_not_a_break():
+    guard = NumericsGuard(name="step")
+    step = guard.wrap(jax.jit(lambda x: x * 2))
+    step(jnp.ones(4, jnp.bfloat16))      # concrete bf16 latches
+    step(0.5)                            # weak Python scalar flip
+    assert guard.weak_upcasts == 1
+    assert guard.contract_breaks == 0
+
+
+def test_numerics_guard_new_treedef_opens_fresh_contract():
+    guard = NumericsGuard(name="step")
+    step = guard.wrap(jax.jit(lambda t: jax.tree.map(
+        lambda a: a + 1, t)))
+    step({"a": jnp.ones(4, jnp.float32)})
+    step({"a": jnp.ones(4, jnp.float32),
+          "b": jnp.ones(4, jnp.bfloat16)})  # new program, new contract
+    assert guard.contract_breaks == 0
+
+
+def test_numerics_guard_forced_nan_counts_exactly_once_per_step():
+    """The in-graph flag (ops.update's `nonfinite` metric) is fed once
+    per step at the epoch fetch: one NaN step is one count, finite
+    steps count nothing, and the flag may be a device scalar."""
+    guard = NumericsGuard(name="step")
+    flag = jax.jit(
+        lambda x: 1.0 - jnp.isfinite(x).astype(jnp.float32))
+    bad = [guard.note_step(flag(x))
+           for x in (1.0, float("nan"), 2.0)]
+    assert bad == [False, True, False]
+    assert guard.stats()["nonfinite_steps"] == 1
+
+
+def test_numerics_guard_budget_raises_past_max_nonfinite():
+    guard = NumericsGuard(max_nonfinite=1, name="update_step")
+    guard.note_step(1.0)                 # at budget: count only
+    with pytest.raises(NumericsError, match="update_step"):
+        guard.note_step(1.0)             # over budget
+    # max_nonfinite=0 means count-and-report, never raise
+    lax = NumericsGuard(max_nonfinite=0, name="step")
+    for _ in range(5):
+        lax.note_step(1.0)
+    assert lax.stats()["nonfinite_steps"] == 5
+
+
+def test_numerics_guard_snapshot_is_a_delta():
+    guard = NumericsGuard(name="step")
+    step = guard.wrap(jax.jit(lambda x: x + 1))
+    step(jnp.ones(4, jnp.float32))
+    step(jnp.ones(4, jnp.bfloat16))
+    guard.note_step(1.0)
+    snap = guard.snapshot()
+    assert snap == {"nonfinite_steps": 1,
+                    "numerics_contract_breaks": 1,
+                    "weak_upcasts": 0}
+    assert guard.snapshot() == {"nonfinite_steps": 0,
+                                "numerics_contract_breaks": 0,
+                                "weak_upcasts": 0}
+
+
+def test_numerics_guard_off_switch_is_a_true_noop():
+    fn = jax.jit(lambda x: x + 1)
+    guard = NumericsGuard(name="step", enabled=False)
+    assert guard.wrap(fn) is fn          # identity, zero overhead
+    assert guard.note_step(1.0) is False  # disabled: nothing counts
+    assert guard.stats() == {"nonfinite_steps": 0,
+                             "numerics_contract_breaks": 0,
+                             "weak_upcasts": 0,
+                             "max_nonfinite_steps": 0}

@@ -1,0 +1,310 @@
+"""numlint rule suite: every dtype/precision rule fires on its positive
+fixture, stays quiet on its negative, and obeys suppression comments —
+plus the dtype-lattice machinery (config facts, weak-type promotion
+algebra, param seeding from call sites, the compute-set closure through
+function-valued jit/grad arguments), the unified-CLI surface (--num),
+and the repo gate: the shipped package must num-lint clean WITH the
+lattice verifiably populated (the ``compute_dtype``/``obs_store``
+config facts, the update step's bf16 cast summary, and the loss path
+inside the compute set must all be discovered, or the gate would be
+vacuously green).
+
+Fixture convention (tests/fixtures/numlint/): ``<rule>_pos.py`` must
+produce findings of exactly that rule under the base+num rule set,
+``<rule>_neg.py`` and ``<rule>_supp.py`` must produce none (driver
+shared with the base/shard/comm/race suites: tests/lintfix.py).  The
+fixtures are parsed, never imported."""
+
+import json
+import os
+
+import pytest
+from lintfix import check_fixture, fixture_path
+
+from handyrl_tpu.analysis.astutil import ModuleInfo, Package
+from handyrl_tpu.analysis.commrules import COMM_RULES
+from handyrl_tpu.analysis.jaxlint import (
+    active_registry,
+    lint_paths,
+    load_package,
+    main,
+)
+from handyrl_tpu.analysis.numlint import (
+    DtypeFact,
+    analyze_num,
+    parse_dtype,
+    promote,
+)
+from handyrl_tpu.analysis.numrules import NUM_RULES
+from handyrl_tpu.analysis.racerules import RACE_RULES
+from handyrl_tpu.analysis.rules import RULES
+from handyrl_tpu.analysis.shardrules import SHARD_RULES
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures",
+                        "numlint")
+REPO_PACKAGE = os.path.join(
+    os.path.dirname(__file__), "..", "handyrl_tpu")
+
+RULE_IDS = sorted(NUM_RULES)
+
+
+def fixture(rule_id, kind):
+    return fixture_path("numlint", rule_id, kind)
+
+
+def _analyze(src):
+    package = Package([ModuleInfo("m", "m", src)])
+    return analyze_num(package), package
+
+
+def _fn(package, qname):
+    return next(fn for fn in package.all_functions()
+                if fn.qname == qname)
+
+
+@pytest.mark.parametrize("kind", ["pos", "neg", "supp"])
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_rule_fixture(rule_id, kind):
+    check_fixture("numlint", rule_id, kind, num=True)
+
+
+def test_num_registry_is_exactly_the_issue_rule_set():
+    assert set(RULE_IDS) == {
+        "implicit-upcast", "weak-type-promotion", "lowp-accum",
+        "unguarded-cast", "dtype-split-brain", "nonfinite-risk"}
+
+
+def test_registries_do_not_collide():
+    # one suppression namespace across all five families
+    assert not set(NUM_RULES) & set(RULES)
+    assert not set(NUM_RULES) & set(SHARD_RULES)
+    assert not set(NUM_RULES) & set(COMM_RULES)
+    assert not set(NUM_RULES) & set(RACE_RULES)
+    combined = active_registry(shard=True, comm=True, race=True,
+                               num=True)
+    assert set(combined) == (set(RULES) | set(SHARD_RULES)
+                             | set(COMM_RULES) | set(RACE_RULES)
+                             | set(NUM_RULES))
+
+
+def test_other_family_fixtures_stay_quiet_under_num_rules():
+    """The base/shard/comm/race fixtures must not trip the num rules:
+    the five families stay independently testable."""
+    for family in ("jaxlint", "shardlint", "commlint", "racelint"):
+        tree = os.path.join(os.path.dirname(__file__), "fixtures",
+                            family)
+        findings = lint_paths([tree], num=True,
+                              select=sorted(NUM_RULES))
+        assert findings == [], (
+            f"num rules fired on {family} fixtures: "
+            f"{[(f.rule, f.path, f.line) for f in findings]}")
+
+
+def test_num_fixtures_stay_quiet_under_shard_rules():
+    findings = lint_paths([FIXTURES], shard=True,
+                          select=sorted(SHARD_RULES))
+    assert findings == [], (
+        f"shard rules fired on num fixtures: "
+        f"{[(f.rule, f.path, f.line) for f in findings]}")
+
+
+# -- dtype lattice machinery -------------------------------------------
+
+def test_promote_weak_scalar_does_not_widen_concrete():
+    """JAX weak-type semantics: a Python float times a bf16 array
+    stays bf16; two concrete float widths promote to the wider."""
+    bf16 = DtypeFact("bfloat16")
+    weak = DtypeFact("float32", weak=True)
+    assert promote(bf16, weak).dtype == "bfloat16"
+    assert promote(weak, bf16).dtype == "bfloat16"
+    f32 = DtypeFact("float32")
+    assert promote(bf16, f32).dtype == "float32"
+    # bf16 x fp16 have equal rank: JAX resolves the tie at float32
+    assert promote(bf16, DtypeFact("float16")).dtype == "float32"
+
+
+def test_parse_dtype_canonicalizes_spellings():
+    assert parse_dtype("bf16") == "bfloat16"
+    assert parse_dtype("jnp.bfloat16") == "bfloat16"
+    assert parse_dtype("half") == "float16"
+    assert parse_dtype("np.uint8") == "uint8"
+    assert parse_dtype("not-a-dtype") is None
+
+
+def test_config_facts_are_harvested_package_wide():
+    an, _ = _analyze(
+        "import numpy as np\n\n"
+        "class Cfg:\n"
+        "    def __init__(self, cfg):\n"
+        "        self.compute_dtype = cfg.get('compute_dtype') "
+        "or 'bfloat16'\n"
+        "        self.obs_store = {'uint8': np.uint8}.get('uint8', "
+        "np.float32)\n")
+    assert "bfloat16" in an.config_facts.get("compute_dtype", set())
+    assert "uint8" in an.config_facts.get("obs_store", set())
+
+
+def test_param_dtypes_seed_from_call_sites_and_defaults():
+    """The make_apply_fn idiom: a param named after a config fact
+    inherits the configured dtype on top of its literal default."""
+    an, pkg = _analyze(
+        "import jax.numpy as jnp\n\n"
+        "compute_dtype = 'bfloat16'\n\n"
+        "def make(compute_dtype='float32'):\n"
+        "    dtype = jnp.dtype(compute_dtype)\n"
+        "    return cast(dtype)\n\n"
+        "def cast(dtype):\n"
+        "    return jnp.zeros((2,)).astype(dtype)\n")
+    cast = _fn(pkg, "m:cast")
+    assert an.param_dtypes[cast]["dtype"] >= {"bfloat16", "float32"}
+    assert an.fn_casts[cast] >= {"bfloat16", "float32"}
+
+
+def test_compute_set_closes_over_function_valued_grad_args():
+    """`jax.grad(loss_fn)` inside a jitted step pulls loss_fn AND its
+    callees into the compute set — the channel that puts the real loss
+    path in scope for the compute-only rules."""
+    an, pkg = _analyze(
+        "import jax\n\n"
+        "@jax.jit\n"
+        "def step(params, batch):\n"
+        "    return jax.grad(loss_fn)(params, batch)\n\n"
+        "def loss_fn(params, batch):\n"
+        "    return helper(params)\n\n"
+        "def helper(params):\n"
+        "    return params\n\n"
+        "def host_only(x):\n"
+        "    return x\n")
+    names = {fn.qname for fn in an.compute_fns}
+    assert {"m:step", "m:loss_fn", "m:helper"} <= names
+    assert "m:host_only" not in names
+
+
+def test_return_summary_flows_across_calls():
+    """A callee that always returns bf16 seeds the caller's local —
+    the interprocedural edge behind cross-function upcast findings."""
+    an, pkg = _analyze(
+        "import jax\nimport jax.numpy as jnp\n\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    h = embed(x)\n"
+        "    return h\n\n"
+        "def embed(x):\n"
+        "    return x.astype(jnp.bfloat16)\n")
+    embed = _fn(pkg, "m:embed")
+    assert an.returns[embed] == DtypeFact("bfloat16")
+
+
+# -- CLI ---------------------------------------------------------------
+
+def test_cli_num_flag_runs_num_rules(capsys):
+    rc = main(["--num", "--json", fixture("lowp-accum", "pos")])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["findings"]
+    assert all(f["rule"] == "lowp-accum" for f in out["findings"])
+
+
+def test_cli_without_num_flag_skips_num_rules(capsys):
+    rc = main([fixture("lowp-accum", "pos")])
+    assert rc == 0
+    assert "clean" in capsys.readouterr().out
+
+
+def test_cli_num_composes_with_other_families(capsys):
+    rc = main(["--shard", "--comm", "--race", "--num", "--json",
+               fixture("nonfinite-risk", "pos")])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert all(f["rule"] == "nonfinite-risk"
+               for f in out["findings"])
+
+
+def test_cli_list_rules_shows_num_family_without_flag(capsys):
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule_id in sorted(NUM_RULES):
+        assert rule_id in out
+
+
+def test_cli_select_accepts_num_rules_only_with_flag(capsys):
+    assert main(["--select", "lowp-accum", FIXTURES]) == 2
+    capsys.readouterr()
+    rc = main(["--num", "--select", "lowp-accum",
+               fixture("lowp-accum", "pos")])
+    assert rc == 1
+
+
+def test_cli_sarif_includes_num_rules(capsys):
+    rc = main(["--num", "--sarif", fixture("implicit-upcast", "pos")])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    rule_ids = {r["id"]
+                for r in doc["runs"][0]["tool"]["driver"]["rules"]}
+    assert set(NUM_RULES) <= rule_ids
+
+
+# -- repo gate ---------------------------------------------------------
+
+def test_repo_numlints_clean():
+    """The CI gate, enforced locally too: the shipped package must have
+    zero unsuppressed findings under the base+num rule set."""
+    findings = lint_paths([REPO_PACKAGE], num=True)
+    assert findings == [], "\n".join(
+        f"{f.location}: [{f.rule}] {f.message}" for f in findings)
+
+
+def test_repo_all_five_families_clean():
+    findings = lint_paths([REPO_PACKAGE], shard=True, comm=True,
+                          race=True, num=True)
+    assert findings == [], "\n".join(
+        f"{f.location}: [{f.rule}] {f.message}" for f in findings)
+
+
+def test_repo_dtype_lattice_is_populated():
+    """The gate above is only meaningful if the analyzer actually SEES
+    the repo's precision structure: the mixed-precision config facts,
+    the update path's bf16/fp32 cast pair, and the loss functions
+    inside the compute set must all be discovered, or a refactor that
+    hides them would silently disable every dtype rule."""
+    package, _, errors = load_package([REPO_PACKAGE])
+    assert errors == []
+    an = analyze_num(package)
+    # the package-wide config facts: the compute dtype defaults to
+    # bfloat16 and observations ride the wire as uint8
+    assert "bfloat16" in an.config_facts.get("compute_dtype", set())
+    assert "uint8" in an.config_facts.get("obs_store", set())
+    # the update step's cast summary: make_apply_fn/_cast_floats cast
+    # to BOTH the bf16 compute dtype and the fp32 master dtype
+    update_casts = set()
+    for fn in package.all_functions():
+        if fn.module.name == "handyrl_tpu.ops.update":
+            update_casts |= an.fn_casts.get(fn, set())
+    assert {"bfloat16", "float32"} <= update_casts
+    # the compute-set closure reaches the loss path through
+    # `jax.grad(loss_fn)` even though `jax.jit(core)` jits a
+    # function-valued parameter the base engine cannot resolve
+    names = {fn.qname for fn in an.compute_fns}
+    assert "handyrl_tpu.ops.losses:compute_loss" in names
+    assert "handyrl_tpu.ops.losses:compose_losses" in names
+    assert "handyrl_tpu.ops.update:make_update_core.loss_fn" in names
+
+
+def test_repo_suppressions_all_carry_reasons():
+    """Zero unexplained suppressions, re-checked end to end (the
+    bare-suppression rule enforces the same convention inline)."""
+    import re
+    pat = re.compile(r"#\s*jaxlint:\s*(disable=[^\n]*|skip-file[^\n]*)")
+    for dirpath, _, files in os.walk(REPO_PACKAGE):
+        for name in files:
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            with open(path) as f:
+                for i, line in enumerate(f, 1):
+                    m = pat.search(line)
+                    if m is None:
+                        continue
+                    assert " -- " in m.group(0), (
+                        f"{path}:{i}: suppression without a reason: "
+                        f"{line.strip()}")

@@ -57,6 +57,11 @@ def test_local_training_two_epochs(tmp_path, monkeypatch):
             # stall_events > 0 here
             "stall_watchdog": True,
             "max_stall_seconds": 30.0,
+            # numerics guard armed for real: the update step's dtype
+            # contract must hold for the whole run and the in-graph
+            # loss/grad-norm finiteness flag must stay 0 every step
+            "numerics_guard": True,
+            "max_nonfinite_steps": 1,
             "metrics_path": "metrics.jsonl",
             # telemetry armed at the DEFAULT sample rate: the pipeline
             # metrics must land in every epoch record, and the span
@@ -108,6 +113,13 @@ def test_local_training_two_epochs(tmp_path, monkeypatch):
         # taken in conflicting orders
         assert "lock_contention_sec" in record
         assert record["lock_order_inversions"] == 0
+        # the numerics guard is armed (max_nonfinite_steps=1 would
+        # raise at the SECOND NaN step — the == 0 asserts here are
+        # what enforce zero): no update step went NaN/Inf and every
+        # argument leaf kept its first-call dtype/weak-type
+        assert record["nonfinite_steps"] == 0
+        assert record["numerics_contract_breaks"] == 0
+        assert "weak_upcasts" in record
         # pipeline telemetry, present EVERY epoch: off-policy staleness
         # is finite and the epoch's wall time splits into feed wait vs
         # device work (batch_wait_sec is 0.0 on the device-replay path
