@@ -1075,6 +1075,299 @@ def serve_main(rounds=2):
     print(json.dumps(out))
 
 
+def router_child(mode, seconds=6.0, clients=12):
+    """One pool-routing load leg (a subprocess, like serve_child):
+    ``single`` runs one ServingFrontend hit directly (the baseline);
+    ``pool`` runs TWO frontends registered into a RouterFrontend via
+    real ReplicaAnnouncers, with clients hammering the router's one
+    endpoint; ``chaos`` is the pool leg plus a mid-load silent kill of
+    one replica (frontend + announcer, no goodbye) — emitting
+    ``recovery_sec`` (kill -> next routed ok), ``eviction_sec`` (kill
+    -> registry sweep eviction, gated by heartbeat_timeout), the exact
+    ``submitted == ok + shed + errors`` reconciliation at the router,
+    and the respawned replica's registry generation bump."""
+    import threading
+
+    from handyrl_tpu.connection import force_cpu_jax
+
+    force_cpu_jax()
+
+    import numpy as np
+
+    from handyrl_tpu.environment import make_env
+    from handyrl_tpu.models import TPUModel
+    from handyrl_tpu.pipeline import InferenceService, PipelineConfig
+    from handyrl_tpu.serving import ReplicaAnnouncer, RouterConfig, \
+        RouterFrontend, ServingConfig, ServingFrontend
+
+    env = make_env({"env": "TicTacToe"})
+    env.reset()
+    model = TPUModel(env.net())
+    model.init_params(env.observation(env.players()[0]), seed=0)
+    obs = env.observation(env.players()[0])
+
+    pcfg = PipelineConfig.from_config(
+        {"mode": "on", "batch_window": 0.002, "max_batch": 64})
+    scfg = ServingConfig.from_config({
+        "mode": "on", "port": 0, "reply_timeout": 3.0, "slo_ms": 0.0})
+    svc = InferenceService(model, pcfg, epoch=1)
+    svc.start()
+
+    n_replicas = 1 if mode == "single" else 2
+    # both replicas share ONE inference service (one jit on this
+    # single-core host): the leg measures the ROUTING plane — spread,
+    # eviction, re-route — not duplicated model compute
+    frontends = [ServingFrontend(svc, env, scfg)
+                 for _ in range(n_replicas)]
+    for fe in frontends:
+        fe.start()
+
+    router = None
+    announcers = []
+    if mode == "single":
+        target_port = frontends[0].port
+    else:
+        rcfg = RouterConfig.from_config({
+            "mode": "on", "port": 0,
+            # tight cadence so the chaos leg's sweep eviction lands
+            # inside the measurement window
+            "heartbeat_interval": 0.25, "heartbeat_timeout": 1.0,
+            "reply_timeout": 3.0,
+            # strictest breaker: the first transport failure against a
+            # replica drains it until its next heartbeat
+            "replica_failures": 0, "failure_window": 5.0})
+        router = RouterFrontend(rcfg)
+        router.start()
+        for i, fe in enumerate(frontends):
+            ann = ReplicaAnnouncer(
+                "127.0.0.1", router.port, f"replica-{i}",
+                (lambda fe=fe: fe.advert(epochs=(1,))),
+                interval=rcfg.heartbeat_interval)
+            ann.start()
+            announcers.append(ann)
+        deadline = time.monotonic() + 10.0
+        while (router.registry.pool_size() < n_replicas
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        target_port = router.port
+
+    warm = max(2.5, 0.3 * seconds)
+    t_start = time.monotonic()
+    t_measure = t_start + warm
+    t_end = t_measure + seconds
+    stop = threading.Event()
+
+    import pickle as _pickle
+    import socket as _socket
+    import struct as _struct
+
+    row = np.asarray(obs)[None]
+    req_payload = _pickle.dumps(("infer", {"obs": row, "epoch": None}),
+                                protocol=_pickle.HIGHEST_PROTOCOL)
+    req_frame = _struct.pack("!I", len(req_payload)) + req_payload
+
+    def _recv_reply(sock):
+        buf = b""
+        while len(buf) < 4:
+            chunk = sock.recv(4 - len(buf))
+            if not chunk:
+                raise ConnectionError("peer closed")
+            buf += chunk
+        (n,) = _struct.unpack("!I", buf)
+        body = bytearray()
+        while len(body) < n:
+            chunk = sock.recv(n - len(body))
+            if not chunk:
+                raise ConnectionError("peer closed mid-reply")
+            body += chunk
+        return _pickle.loads(bytes(body))
+
+    def load(idx, out):
+        sock = None
+        ok = shed = errors = drops = 0
+        lats = []
+        while not stop.is_set() and time.monotonic() < t_end:
+            try:
+                if sock is None:
+                    sock = _socket.create_connection(
+                        ("127.0.0.1", target_port), timeout=5.0)
+                t0 = time.perf_counter()
+                sock.sendall(req_frame)
+                reply = _recv_reply(sock)
+                dt_ms = (time.perf_counter() - t0) * 1e3
+                if time.monotonic() < t_measure:
+                    continue
+                status = reply.get("status")
+                if status == "ok":
+                    ok += 1
+                    lats.append(dt_ms)
+                elif status == "shed":
+                    shed += 1
+                else:
+                    errors += 1
+            except Exception:
+                drops += 1
+                if sock is not None:
+                    sock.close()
+                sock = None
+                time.sleep(0.05)
+        if sock is not None:
+            sock.close()
+        out[idx] = {"ok": ok, "shed": shed, "errors": errors,
+                    "drops": drops, "lats": lats}
+
+    results = {}
+    threads = [threading.Thread(target=load, args=(i, results),
+                                daemon=True)
+               for i in range(clients)]
+    for t in threads:
+        t.start()
+
+    chaos_out = {}
+    if mode == "chaos":
+        time.sleep(warm + 0.35 * seconds)
+        victim_fe, victim_ann = frontends[1], announcers[1]
+        ok_at_kill = router.stats()["ok"]
+        t_kill = time.monotonic()
+        # silent death: no drain, no goodbye — the router must learn
+        # from transport failures (immediate suspect-drain + re-route)
+        # and from missing heartbeats (sweep eviction)
+        victim_ann.kill()
+        victim_fe.inject_kill()
+        while (router.stats()["ok"] <= ok_at_kill
+               and time.monotonic() < t_end):
+            time.sleep(0.005)
+        recovery_sec = time.monotonic() - t_kill
+        while (router.registry.pool_size() > 1
+               and time.monotonic() < t_end):
+            time.sleep(0.02)
+        eviction_sec = time.monotonic() - t_kill
+        # respawn: fresh listener, fresh announcer loop — the
+        # re-register under the same name bumps the generation
+        victim_fe.respawn()
+        victim_ann.respawn()
+        deadline = time.monotonic() + 10.0
+        while (router.registry.generation("replica-1") != 1
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        chaos_out = {
+            "recovery_sec": round(recovery_sec, 3),
+            "eviction_sec": round(eviction_sec, 3),
+            "evicted_within_timeout": eviction_sec
+            <= rcfg.heartbeat_timeout + 2 * router.ACCEPT_TIMEOUT,
+            "generation_bump":
+                router.registry.generation("replica-1") == 1,
+            "pool_recovered": router.registry.pool_size() == 2,
+        }
+    for t in threads:
+        t.join(timeout=warm + seconds + 15)
+    stop.set()
+    time.sleep(scfg.reply_timeout + 0.5)
+
+    stats = router.stats() if router is not None else \
+        frontends[0].stats()
+    lats = sorted(l for r in results.values() for l in r["lats"])
+    ok = sum(r["ok"] for r in results.values())
+    out = {
+        "mode": mode,
+        "clients": clients,
+        "replicas": n_replicas,
+        "rps": round(ok / seconds, 1),
+        "ok": ok,
+        "shed": sum(r["shed"] for r in results.values()),
+        "errors": sum(r["errors"] for r in results.values()),
+        "conn_drops": sum(r["drops"] for r in results.values()),
+        "p50_ms": round(lats[len(lats) // 2], 3) if lats else None,
+        "p99_ms": round(lats[min(len(lats) - 1,
+                                 int(0.99 * len(lats)))], 3)
+        if lats else None,
+        # router-side (or frontend-side, single) reconciliation: every
+        # arrival accounted as exactly one of ok/shed/error
+        "submitted": stats["submitted"],
+        "reconciled": stats["submitted"]
+        == stats["ok"] + stats["shed"] + stats["errors"],
+        **chaos_out,
+    }
+    if router is not None:
+        out["reroutes"] = stats["reroutes"]
+        out["pool_sheds"] = stats["pool_sheds"]
+        out["evictions"] = stats["registry"]["evictions"]
+    for ann in announcers:
+        ann.close(drain=False)
+    if router is not None:
+        router.close()
+    for fe in frontends:
+        fe.close()
+    svc.close()
+    print(json.dumps(out))
+    sys.stdout.flush()
+    os._exit(0)
+
+
+def router_main(rounds=2):
+    """Pool-routing variant (one JSON line, like main): closed-loop
+    RPS of a 2-replica pool behind the router vs one frontend hit
+    directly, interleaved pairwise per round (the shared
+    `_interleaved_rounds` discipline), plus a chaos leg (silent kill
+    of one replica mid-load: recovery_sec to the next routed ok,
+    sweep eviction inside the heartbeat timeout, exact reconciliation
+    at the router, and the respawn's registry generation bump)."""
+    runs = _interleaved_rounds(rounds, {
+        "single": lambda: _run_child("--router-child", timeout=600,
+                                     extra=["single"]),
+        "pool": lambda: _run_child("--router-child", timeout=600,
+                                   extra=["pool"]),
+        "chaos": lambda: _run_child("--router-child", timeout=600,
+                                    extra=["chaos"]),
+    })
+    ratios = _round_ratios(runs["pool"], runs["single"], key="rps")
+    if not ratios:
+        print(json.dumps({"metric": "router_pool_vs_single_rps",
+                          "error": "no complete rounds"}))
+        return
+    pool = [r for r in runs["pool"] if r.get("rps")]
+    single = [r for r in runs["single"] if r.get("rps")]
+    chaos = [r for r in runs["chaos"] if r.get("submitted")]
+    out = {
+        "metric": "router_pool_vs_single",
+        # the routed-path cost/benefit on THIS host: both legs share
+        # one core and one inference service, so the ratio isolates
+        # the router hop (a pool of real hosts adds their compute;
+        # the chaos keys below are the numbers this subsystem is FOR)
+        "value": round(_median(ratios), 3),
+        "unit": ("closed-loop RPS, 2-replica pool behind the router / "
+                 "one frontend direct, TicTacToe net, 12 clients, "
+                 f"median of {len(ratios)} interleaved rounds; "
+                 "chaos leg = silent replica kill -> re-route + "
+                 "sweep eviction + respawn generation bump"),
+        "pool_rps": _median([r["rps"] for r in pool]),
+        "single_rps": _median([r["rps"] for r in single]),
+        "pool_p99_ms": _median(
+            [r["p99_ms"] for r in pool if r.get("p99_ms")]),
+        "pool_reconciled": all(r.get("reconciled") for r in pool),
+        "rounds": {"pool": [r["rps"] for r in pool],
+                   "single": [r["rps"] for r in single],
+                   "ratios": [round(r, 3) for r in ratios]},
+    }
+    if chaos:
+        out["chaos_reconciled"] = all(r.get("reconciled")
+                                      for r in chaos)
+        out["chaos_recovery_sec"] = _median(
+            [r["recovery_sec"] for r in chaos
+             if r.get("recovery_sec") is not None])
+        out["chaos_eviction_sec"] = _median(
+            [r["eviction_sec"] for r in chaos
+             if r.get("eviction_sec") is not None])
+        out["chaos_evicted_within_timeout"] = all(
+            r.get("evicted_within_timeout") for r in chaos)
+        out["chaos_generation_bump"] = all(
+            r.get("generation_bump") for r in chaos)
+        out["chaos_pool_recovered"] = all(
+            r.get("pool_recovered") for r in chaos)
+        out["chaos_rps"] = _median([r["rps"] for r in chaos])
+    print(json.dumps(out))
+
+
 ANAKIN_TRAIN_ARGS = {
     "turn_based_training": True, "observation": False,
     "gamma": 0.8, "forward_steps": 8, "burn_in_steps": 0,
@@ -2022,6 +2315,12 @@ if __name__ == "__main__":
     elif "--serve" in sys.argv:
         tail = [a for a in sys.argv[2:] if a.isdigit()]
         serve_main(rounds=int(tail[0]) if tail else 2)
+    elif "--router-child" in sys.argv:
+        tail = sys.argv[sys.argv.index("--router-child") + 1:]
+        router_child(tail[0] if tail else "pool")
+    elif "--router" in sys.argv:
+        tail = [a for a in sys.argv[2:] if a.isdigit()]
+        router_main(rounds=int(tail[0]) if tail else 2)
     elif "--anakin-child" in sys.argv:
         tail = sys.argv[sys.argv.index("--anakin-child") + 1:]
         digits = [a for a in tail if a.isdigit()]

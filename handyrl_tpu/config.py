@@ -260,6 +260,19 @@ class TrainConfig:
     # requires the inference service (pipeline.mode on, local primary
     # learner).  See docs/serving.md
     serving: Dict[str, Any] = field(default_factory=dict)
+    # -- replica-pool router (handyrl_tpu.serving.router) --
+    # one endpoint over N serving replicas: a service registry each
+    # frontend heartbeats into (capacity, committed epochs, p99,
+    # generation; silent replicas evicted, never routed to) and a
+    # router spreading live traffic least-loaded (or hash on seat),
+    # re-routing epoch pins to any replica advertising the snapshot,
+    # and escalating typed sheds only when the WHOLE pool is
+    # unhealthy.  Keys (validated through RouterConfig.from_config):
+    # mode, port, heartbeat_interval, heartbeat_timeout, policy,
+    # max_attempts, max_inflight, max_connections, reply_timeout,
+    # replica_failures, failure_window.  Empty = off; requires
+    # serving.mode on.  See "Pool routing" in docs/serving.md
+    router: Dict[str, Any] = field(default_factory=dict)
     # -- Anakin mode (handyrl_tpu.anakin; Podracer arXiv:2104.06272) --
     # fused on-device rollout+update for envs with a pure-JAX twin
     # (environment.JAX_ENV_REGISTRY): `mode: on|auto` runs env
@@ -385,14 +398,21 @@ class TrainConfig:
         # serving keys validate through the dataclass the network
         # frontend runs with; the service dependency is checked here
         # because it crosses sections
-        from .serving.config import ServingConfig
+        from .serving.config import RouterConfig, ServingConfig
 
-        if (ServingConfig.from_config(self.serving).enabled
-                and not pipeline_cfg.enabled):
+        serving_cfg = ServingConfig.from_config(self.serving)
+        if serving_cfg.enabled and not pipeline_cfg.enabled:
             raise ValueError(
                 "serving.mode: on needs the batched inference service "
                 "— it feeds the pipeline batching window, so "
                 "pipeline.mode must be on (the default)")
+        # router keys validate through the dataclass the pool router
+        # runs with; the frontend dependency crosses sections
+        if (RouterConfig.from_config(self.router).enabled
+                and not serving_cfg.enabled):
+            raise ValueError(
+                "router.mode: on needs a serving frontend to front — "
+                "serving.mode must be on")
         # anakin keys validate through the dataclass the fused rollout
         # engine runs with; the epoch-cadence requirement is checked
         # here because it crosses fields

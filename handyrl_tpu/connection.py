@@ -87,15 +87,23 @@ class FramedConnection:
         header = struct.pack("!I", len(payload))
         buf = memoryview(header + payload)
         while buf:
-            n = self.sock.send(buf[:CHUNK])
+            sock = self.sock
+            if sock is None:
+                # closed under us (kill/teardown race): a typed
+                # dead-peer error, not an AttributeError on None
+                raise ConnectionResetError("connection closed")
+            n = sock.send(buf[:CHUNK])
             buf = buf[n:]
 
     def _recv_exact(self, n: int, what: str = "frame") -> bytes:
         chunks = io.BytesIO()
         remaining = n
         while remaining:
+            sock = self.sock
+            if sock is None:
+                raise ConnectionResetError("connection closed")
             # jaxlint: disable=unbounded-recv -- the framing layer's raw socket read: a dead peer raises, and a WEDGED peer is severed by the learner's heartbeat sweep (report_stale disconnects the socket, failing this recv)
-            data = self.sock.recv(remaining)
+            data = sock.recv(remaining)
             if not data:
                 got = n - remaining
                 if got:

@@ -1504,6 +1504,18 @@ class Learner:
     _serve_respawns = 0
     _serve_respawn_at = 0.0
     _serve_disabled = False
+    # replica-pool router (handyrl_tpu.serving.router): the one
+    # endpoint over every registered serving replica, hosted by the
+    # primary when router.mode is on; supervised like the frontend
+    router_frontend = None
+    _router_respawns = 0
+    _router_respawn_at = 0.0
+    _router_disabled = False
+    # registry announcer: this replica's register/heartbeat loop into
+    # a pool router (the local one, or serving.router_address)
+    serve_announcer = None
+    _serve_kill_epoch = 0
+    _serve_killed = False
     # shm-vs-spill episode accounting (pipelined dataflow): cumulative
     # and per-epoch counts of episodes that rode the trajectory rings
     # vs episodes stamped ``shm_spilled`` (surge-hold overflow / full
@@ -1657,6 +1669,7 @@ class Learner:
         # (the off/zero states ride the class-level defaults above,
         # the same pattern as _kill_switch/_resume)
         self._infer_kill_epoch = chaos_cfg.infer_kill_epoch
+        self._serve_kill_epoch = chaos_cfg.serve_kill_epoch
         if self._pipeline_cfg.enabled and not remote:
             from .resilience.supervisor import FailureWindow
 
@@ -1716,6 +1729,49 @@ class Learner:
                     max_frame_bytes=int(
                         self.args.get("max_frame_bytes", 0) or 0))
                 self.serve_frontend.start()
+        # replica-pool router (docs/serving.md "Pool routing"): the
+        # primary can host the one-endpoint router over every
+        # registered serving replica; death is a supervised fault
+        # (_router_tick, the _serving_tick ladder)
+        from .serving import RouterConfig
+
+        self._router_cfg = RouterConfig.from_config(
+            self.args.get("router") or {})
+        if (self._router_cfg.enabled and self.primary
+                and self.serve_frontend is not None):
+            from .resilience.supervisor import FailureWindow
+            from .serving import RouterFrontend
+
+            self._router_window = FailureWindow(
+                int(self.args.get("max_respawns", 5)), 60.0)
+            self.router_frontend = RouterFrontend(
+                self._router_cfg,
+                max_frame_bytes=int(
+                    self.args.get("max_frame_bytes", 0) or 0))
+            self.router_frontend.start()
+        # registry announcer: every serving frontend heartbeats its
+        # advert into a pool router — a remote serving.router_address,
+        # or the local router above (its own frontend registers like
+        # any remote one, so single-host runs exercise the pool path)
+        if self.serve_frontend is not None:
+            target = None
+            if self._serving_cfg.router_address:
+                host, _, port = \
+                    self._serving_cfg.router_address.rpartition(":")
+                target = (host, int(port))
+            elif self.router_frontend is not None:
+                target = ("127.0.0.1", self.router_frontend.port)
+            if target is not None:
+                from .serving import ReplicaAnnouncer
+
+                self.serve_announcer = ReplicaAnnouncer(
+                    target[0], target[1],
+                    f"learner-{jax.process_index()}-{os.getpid()}",
+                    self._serving_advert,
+                    interval=self._router_cfg.heartbeat_interval,
+                    max_frame_bytes=int(
+                        self.args.get("max_frame_bytes", 0) or 0))
+                self.serve_announcer.start()
         # stall watchdog: the server loop and the communicator's
         # reader/writer threads beat once per pass; a loop silent past
         # max_stall_seconds is a counted stall_event with a stack dump
@@ -1750,6 +1806,7 @@ class Learner:
                     (self.fleet, "_lock"),
                     (self.infer_service, "_lock"),
                     (self.serve_frontend, "_lock"),
+                    (self.router_frontend, "_lock"),
                     (self.stall_watchdog, "_lock"),
             ):
                 self.lock_guard.arm(obj, attr)
@@ -1760,8 +1817,15 @@ class Learner:
         if status_port and self.primary:
             from .telemetry.status import StatusServer
 
+            # a router-hosting learner answers /healthz from the
+            # registry snapshot (pool health, constant-time, no
+            # per-replica dial); otherwise the constant liveness body
+            healthz_fn = None
+            if self.router_frontend is not None:
+                healthz_fn = self.router_frontend.healthz
             self.status = StatusServer(status_port,
-                                       self._status_snapshot)
+                                       self._status_snapshot,
+                                       healthz_fn=healthz_fn)
 
     def _status_snapshot(self):
         """Live JSON for the status endpoint: fleet + telemetry + the
@@ -1812,7 +1876,38 @@ class Learner:
                 **self.serve_frontend.stats(),
                 "respawns": self._serve_respawns,
             }
+            if self.serve_announcer is not None:
+                snap["serving"]["announcer"] = {
+                    "alive": self.serve_announcer.alive,
+                    "generation": self.serve_announcer.generation,
+                    "registrations":
+                        self.serve_announcer.registrations,
+                }
+        if self.router_frontend is not None:
+            # pool routing (docs/serving.md "Pool routing"): router
+            # counters + the registry snapshot (pool membership,
+            # per-replica generation/age/advert)
+            snap["router"] = {
+                **self.router_frontend.stats(),
+                "respawns": self._router_respawns,
+            }
         return snap
+
+    def _serving_advert(self):
+        """This replica's registry advert (announcer callback, runs on
+        the announcer thread): the frontend's capacity/load/p99 plus
+        the committed epochs pinned requests can route here for — the
+        manifest's entries, exactly what the serving resolver can load
+        (digest verification happens at resolve time; the advert is a
+        cheap bulletin, not a proof)."""
+        epochs = {int(self.model_epoch)}
+        if self.manifest is not None:
+            try:
+                epochs.update(
+                    int(e) for e in self.manifest.load()["entries"])
+            except (ValueError, TypeError, OSError):
+                pass
+        return self.serve_frontend.advert(epochs=epochs)
 
     # -- durability ---------------------------------------------------
     def _wal_keep_episodes(self):
@@ -1963,6 +2058,19 @@ class Learner:
                 print(f"CHAOS: killing the inference service at epoch "
                       f"{self.model_epoch}")
                 self.infer_service.inject_kill()
+        if (self.serve_frontend is not None
+                and self._serve_kill_epoch > 0 and not self._serve_killed
+                and self.model_epoch >= self._serve_kill_epoch):
+            # pool-routing chaos: this replica goes SILENT — frontend
+            # and announcer die without a goodbye, so the router must
+            # learn of the death from missing heartbeats (sweep
+            # eviction) and re-route, pins included, to the survivors
+            self._serve_killed = True
+            print(f"CHAOS: killing the serving replica at epoch "
+                  f"{self.model_epoch}")
+            if self.serve_announcer is not None:
+                self.serve_announcer.kill()
+            self.serve_frontend.inject_kill()
         if not self.primary:
             # replicas serve the in-memory snapshot to their own
             # workers; only process 0 writes the checkpoint dir
@@ -2250,6 +2358,14 @@ class Learner:
             # typed replies, never silent drops
             record.update(self.serve_frontend.epoch_stats())
             record["serve_respawns"] = self._serve_respawns
+        if self.router_frontend is not None:
+            # pool-routing telemetry (docs/observability.md):
+            # router_pool_size / reroutes / pool_sheds join the
+            # serve_* keys; the plot script reads them through the
+            # series() skip-absent pattern, so pre-router metrics
+            # files still render
+            record.update(self.router_frontend.epoch_stats())
+            record["router_respawns"] = self._router_respawns
         if self.stall_watchdog is not None:
             # control-plane wedges this epoch (server loop + reader/
             # writer threads silent past max_stall_seconds); steady
@@ -2495,6 +2611,54 @@ class Learner:
             self._serve_respawns += 1
             print("serving frontend respawned "
                   f"(incarnation {fe.generation})")
+            if self.serve_announcer is not None:
+                # the respawned frontend must re-enter the pool: the
+                # announcer's fresh register bumps this replica's
+                # registry generation — how the respawn is observed
+                # pool-wide
+                self.serve_announcer.respawn()
+
+    def _router_tick(self):
+        """Once per server-loop pass: supervise the pool router the
+        way ``_serving_tick`` supervises the frontend — backoff
+        respawn behind the windowed circuit breaker; a trip disables
+        pool routing for the run, never training."""
+        rt = self.router_frontend
+        if (rt is None or rt.alive or self._router_disabled
+                or self.shutdown_flag):
+            return
+        now = time.monotonic()
+        if self._router_respawn_at == 0.0:
+            if self._router_window.record(now):
+                self._router_disabled = True
+                print("ERROR: the pool router keeps dying (circuit "
+                      "breaker tripped); pool routing disabled for "
+                      "this run — training continues")
+                rt.close()
+                return
+            delay = float(self.args.get("respawn_backoff", 0.5) or 0.5)
+            self._router_respawn_at = now + delay
+            print(f"WARNING: pool router died; respawning in "
+                  f"{delay:.1f}s (pool clients see refused "
+                  f"connections meanwhile)")
+        elif now >= self._router_respawn_at:
+            self._router_respawn_at = 0.0
+            try:
+                rt.respawn()
+            except Exception as exc:
+                print(f"WARNING: pool router respawn failed "
+                      f"({exc!r}); retrying through the backoff "
+                      f"ladder")
+                return
+            self._router_respawns += 1
+            print(f"pool router respawned "
+                  f"(incarnation {rt.generation})")
+            if (self.serve_announcer is not None
+                    and not self._serving_cfg.router_address):
+                # the local announcer dials the router's port; with
+                # port 0 a respawn rebinds fresh, so point it at the
+                # new incarnation before its next retry
+                self.serve_announcer.port = rt.port
 
     # -- server loop -------------------------------------------------
     def _on_beat(self, beats):
@@ -2544,6 +2708,7 @@ class Learner:
             # epoch cadence as control-plane arrivals below
             self._pipeline_tick()
             self._serving_tick()
+            self._router_tick()
 
             if conn is not None:
                 self.fleet.observe(conn, verb, payload)
@@ -2703,6 +2868,13 @@ class Learner:
                 self.stall_watchdog.stop()
             if self.status is not None:
                 self.status.close()
+            if self.serve_announcer is not None:
+                # graceful goodbye FIRST: the router drains this
+                # replica (in-flight forwards finish, nothing new
+                # routes here) before its listener goes away
+                self.serve_announcer.close()
+            if self.router_frontend is not None:
+                self.router_frontend.close()
             if self.serve_frontend is not None:
                 # the frontend rides the service: close it first so no
                 # handler thread submits into a closing service

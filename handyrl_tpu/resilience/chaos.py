@@ -69,6 +69,14 @@ class ChaosConfig:
     # learner epoch reaches this — workers must fall back to local CPU
     # inference and the learner must respawn the service.  Fires once
     infer_kill_epoch: int = 0     # learner epoch of the kill; 0 = off
+    # -- scheduled SERVING-REPLICA kill (pool-routing chaos): this
+    # learner's serving frontend AND its registry announcer die
+    # silently when the learner epoch reaches this — the pool router
+    # must evict the silent replica within its heartbeat timeout and
+    # re-route (pins included) to the survivors; the learner's serving
+    # tick then respawns both and the re-registration bumps the
+    # replica's registry generation.  Fires once
+    serve_kill_epoch: int = 0     # learner epoch of the kill; 0 = off
     # -- shm-plane fault injection (the pipeline's seqlock rings and
     # heartbeat board; ChaosRing/ChaosBoard wrap the endpoints when
     # any of these are armed).  Probabilities are per opportunity:
@@ -112,7 +120,8 @@ class ChaosConfig:
                      "surge_hold_uploads", "max_kills", "surge_epoch",
                      "surge_kills", "learner_kill_epoch",
                      "learner_kill_after_episodes",
-                     "infer_kill_epoch", "shm_beat_delay"):
+                     "infer_kill_epoch", "serve_kill_epoch",
+                     "shm_beat_delay"):
             if getattr(cfg, name) < 0:
                 raise ValueError(f"chaos.{name} must be >= 0")
         for group, names in (
@@ -153,6 +162,10 @@ class ChaosConfig:
     @property
     def infer_kill_enabled(self) -> bool:
         return self.infer_kill_epoch > 0
+
+    @property
+    def serve_kill_enabled(self) -> bool:
+        return self.serve_kill_epoch > 0
 
     @property
     def shm_faults_enabled(self) -> bool:

@@ -11,27 +11,37 @@ snapshots as first-class serving targets).
 Public surface:
 
   * :class:`.config.ServingConfig` — the validated ``serving.*`` keys;
+  * :class:`.config.RouterConfig` — the validated ``router.*`` keys;
   * :class:`.frontend.ServingFrontend` — the learner-side acceptor;
+  * :class:`.registry.ServiceRegistry` /
+    :class:`.registry.ReplicaAnnouncer` — the pool bulletin and the
+    replica-side heartbeat loop (docs/serving.md "Pool routing");
+  * :class:`.router.RouterFrontend` — the one-endpoint pool router;
   * :class:`.client.ServeClient` (+ :class:`.client.ShedError` /
     :class:`.client.ServeError`) — the consumer SDK.
 
-``ServingConfig`` imports eagerly (config validation reads it without
-jax); the frontend and client resolve lazily (PEP 562) so importing
+The config classes import eagerly (config validation reads them
+without jax); everything else resolves lazily (PEP 562) so importing
 the package stays cheap for config-only consumers — the same
 convention as ``handyrl_tpu.anakin``.
 """
 
-from .config import ServingConfig  # noqa: F401
+from .config import RouterConfig, ServingConfig  # noqa: F401
 
 _LAZY = {
     "ServingFrontend": ("handyrl_tpu.serving.frontend",
                         "ServingFrontend"),
+    "ServiceRegistry": ("handyrl_tpu.serving.registry",
+                        "ServiceRegistry"),
+    "ReplicaAnnouncer": ("handyrl_tpu.serving.registry",
+                         "ReplicaAnnouncer"),
+    "RouterFrontend": ("handyrl_tpu.serving.router", "RouterFrontend"),
     "ServeClient": ("handyrl_tpu.serving.client", "ServeClient"),
     "ShedError": ("handyrl_tpu.serving.client", "ShedError"),
     "ServeError": ("handyrl_tpu.serving.client", "ServeError"),
 }
 
-__all__ = ["ServingConfig", *_LAZY]
+__all__ = ["ServingConfig", "RouterConfig", *_LAZY]
 
 
 def __getattr__(name):

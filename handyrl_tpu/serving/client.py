@@ -66,14 +66,20 @@ class ServeClient:
             raise ServeError(reply)
         raise ServeError({"reason": f"malformed reply {reply!r}"})
 
-    def infer_batch(self, obs_batch, epoch=None):
+    def infer_batch(self, obs_batch, epoch=None, seat=None):
         """Row-batched forward: ``obs_batch`` is an observation tree
         with a leading row dimension on every leaf.  Returns
         ``{"epoch": served_epoch, "outputs": {...row-batched...}}``
         (the reply's payload fields, status stripped).
         ``epoch`` pins the request to that exact snapshot (multi-model
-        routing); None serves the live model."""
-        reply = self._call("infer", {"obs": obs_batch, "epoch": epoch})
+        routing); None serves the live model.  ``seat`` is an opaque
+        affinity key: a pool router with ``router.policy: hash`` sends
+        every request carrying the same seat to the same replica (a
+        single frontend ignores it)."""
+        payload = {"obs": obs_batch, "epoch": epoch}
+        if seat is not None:
+            payload["seat"] = seat
+        reply = self._call("infer", payload)
         return {"epoch": reply["epoch"], "outputs": reply["outputs"]}
 
     def infer(self, obs, epoch=None):

@@ -16,7 +16,10 @@ from typing import Any, Dict, Optional
 
 MODES = ("off", "on")
 
-SERVE_PORT = 9995  # next to the worker plane's 9998/9999
+SERVE_PORT = 9995   # next to the worker plane's 9998/9999
+ROUTER_PORT = 9994  # the pool endpoint, next to the serving port
+
+ROUTER_POLICIES = ("least_loaded", "hash")
 
 
 @dataclass
@@ -66,6 +69,11 @@ class ServingConfig:
     # LRU capacity for routed past-epoch snapshots (multi-model
     # routing; the live model rides outside this cache)
     snapshot_cache: int = 4
+    # "host:port" of a pool router this frontend announces itself to
+    # on a heartbeat cadence (see RouterConfig below); "" = announce
+    # only to a router hosted by the SAME learner (router.mode: on),
+    # or not at all when none is
+    router_address: str = ""
 
     @classmethod
     def from_config(cls, raw: Optional[Dict[str, Any]]) -> "ServingConfig":
@@ -93,6 +101,97 @@ class ServingConfig:
             raise ValueError("serving.reply_timeout must be > 0")
         if cfg.snapshot_cache < 1:
             raise ValueError("serving.snapshot_cache must be >= 1")
+        if cfg.router_address:
+            host, sep, port = cfg.router_address.rpartition(":")
+            if not (sep and host and port.isdigit()):
+                raise ValueError(
+                    "serving.router_address must be 'host:port'")
+        return cfg
+
+    @property
+    def enabled(self) -> bool:
+        return self.mode == "on"
+
+
+@dataclass
+class RouterConfig:
+    """Knobs for the replica-pool router (``router:`` section).
+
+    ``mode: on`` makes the primary learner host a
+    :class:`~handyrl_tpu.serving.router.RouterFrontend`: one framed-TCP
+    endpoint presenting every registered serving replica as a single
+    pool — least-loaded (or consistent-hash on ``seat``) spread for
+    live traffic, epoch-pinned requests routed only to replicas
+    advertising that snapshot, typed shed escalation when the whole
+    pool is unhealthy, and FleetRegistry-style heartbeat expiry so a
+    silent replica is evicted, never routed to.  Requires
+    ``serving.mode: on`` (the hosting learner always fronts at least
+    its own frontend).  See "Pool routing" in docs/serving.md.
+    """
+
+    # off | on — whether the primary learner hosts the pool router
+    mode: str = "off"
+    # TCP port for the router's framed protocol; 0 = OS-assigned
+    port: int = ROUTER_PORT
+    # seconds between replica heartbeats; the router assigns this
+    # cadence in its register ack, so the pool beats at ONE rate
+    heartbeat_interval: float = 2.0
+    # seconds of replica silence after which the registry sweep evicts
+    # it (no longer routed to); must exceed heartbeat_interval
+    heartbeat_timeout: float = 6.0
+    # spread policy for unpinned traffic: least_loaded (inflight x
+    # p99 score) or hash (rendezvous hash on the request's seat)
+    policy: str = "least_loaded"
+    # forwarding attempts per request over DISTINCT replicas before
+    # the router escalates to a typed pool-level shed
+    max_attempts: int = 3
+    # admission cap on concurrently-forwarded requests; arrivals past
+    # it shed with reason "overload" (router-local, like a replica's)
+    max_inflight: int = 512
+    # cap on concurrently-open connections (clients + replicas)
+    max_connections: int = 256
+    # seconds one forwarding attempt may take (connect + reply)
+    # before the replica is marked failed and the request re-routes
+    reply_timeout: float = 5.0
+    # per-replica FailureWindow: more than this many transport
+    # failures inside failure_window seconds marks the replica
+    # suspect — drained from routing until its next heartbeat
+    replica_failures: int = 2
+    failure_window: float = 10.0
+
+    @classmethod
+    def from_config(cls, raw: Optional[Dict[str, Any]]) -> "RouterConfig":
+        raw = dict(raw or {})
+        known = {f for f in cls.__dataclass_fields__}
+        unknown = set(raw) - known
+        if unknown:
+            raise ValueError(f"unknown router keys: {sorted(unknown)}")
+        cfg = cls(**raw)
+        if cfg.mode not in MODES:
+            raise ValueError(f"router.mode must be one of {MODES}")
+        if cfg.port < 0:
+            raise ValueError("router.port must be >= 0")
+        if cfg.heartbeat_interval <= 0:
+            raise ValueError("router.heartbeat_interval must be > 0")
+        if cfg.heartbeat_timeout <= cfg.heartbeat_interval:
+            raise ValueError(
+                "router.heartbeat_timeout must exceed "
+                "router.heartbeat_interval")
+        if cfg.policy not in ROUTER_POLICIES:
+            raise ValueError(
+                f"router.policy must be one of {ROUTER_POLICIES}")
+        if cfg.max_attempts < 1:
+            raise ValueError("router.max_attempts must be >= 1")
+        if cfg.max_inflight < 1:
+            raise ValueError("router.max_inflight must be >= 1")
+        if cfg.max_connections < 1:
+            raise ValueError("router.max_connections must be >= 1")
+        if cfg.reply_timeout <= 0:
+            raise ValueError("router.reply_timeout must be > 0")
+        if cfg.replica_failures < 0:
+            raise ValueError("router.replica_failures must be >= 0")
+        if cfg.failure_window <= 0:
+            raise ValueError("router.failure_window must be > 0")
         return cfg
 
     @property

@@ -500,6 +500,30 @@ class ServingFrontend:
             out["serve_max_ms"] = round(hist.max_ms, 3)
         return out
 
+    def advert(self, epochs=()):
+        """This replica's registry advertisement (the pool-router wire
+        format, docs/serving.md "Pool routing"): capacity and load for
+        the least-loaded spread, the sliding-window p99 + breach flag
+        for pool-level SLO escalation, and the committed ``epochs``
+        this replica can serve pinned requests for (the caller supplies
+        them — the checkpoint manifest is learner state, not frontend
+        state)."""
+        with self._lock:
+            if self._window:
+                srt = sorted(self._window)
+                p99 = srt[min(len(srt) - 1, int(0.99 * len(srt)))]
+            else:
+                p99 = 0.0
+            return {
+                "port": self.port,
+                "capacity": int(self.cfg.max_inflight),
+                "inflight": self.inflight,
+                "p99_ms": round(p99, 3),
+                "slo_breached": self._breached,
+                "generation": self.generation,
+                "epochs": sorted(int(e) for e in epochs),
+            }
+
     def stats(self):
         """Cumulative snapshot (status endpoint + the ``stats`` verb).
         Every count is monotone; ``submitted == ok + shed + errors``

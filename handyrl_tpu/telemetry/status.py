@@ -10,7 +10,10 @@ cannot mutate anything: every method but GET is rejected).
 WITHOUT invoking the snapshot callable: the liveness probe for load
 balancers fronting the serving tier and for the frontend's own
 supervision — pollers at high frequency must not pay (or race) the
-full snapshot assembly just to learn the process is alive.
+full snapshot assembly just to learn the process is alive.  A host
+fronting a replica POOL passes ``healthz_fn`` (the router's
+registry-snapshot answer) and /healthz serves that instead — still
+constant-time bookkeeping, still no per-replica dial.
 
 Runs a ThreadingHTTPServer on a daemon thread; the snapshot callable is
 invoked per request on the server thread, so it must only read
@@ -27,16 +30,29 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 class StatusServer:
     """Serve ``snapshot_fn()`` as JSON on every GET."""
 
-    def __init__(self, port, snapshot_fn):
+    def __init__(self, port, snapshot_fn, healthz_fn=None):
         self.snapshot_fn = snapshot_fn
+        self.healthz_fn = healthz_fn
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):
                 if self.path.split("?", 1)[0] == "/healthz":
-                    # liveness only: constant body, no snapshot call
-                    body = b'{"ok": true}'
-                    self.send_response(200)
+                    # liveness only: constant body (or the router's
+                    # registry-bookkeeping answer) — NEVER the full
+                    # snapshot, never a per-replica dial
+                    if outer.healthz_fn is None:
+                        body = b'{"ok": true}'
+                        code = 200
+                    else:
+                        try:
+                            body = json.dumps(outer.healthz_fn()).encode()
+                            code = 200
+                        except Exception as exc:
+                            body = json.dumps(
+                                {"ok": False, "error": repr(exc)}).encode()
+                            code = 500
+                    self.send_response(code)
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()

@@ -1,0 +1,223 @@
+"""PR 18 smoke drive: the replica-pool router on a live training run.
+
+Runs a short local TicTacToe training with `serving.mode: on` AND
+`router.mode: on` — the learner hosts the frontend, the router, and
+the announcer that registers the frontend into the router's pool —
+and drives the ROUTER endpoint from real network clients while it
+trains: unpinned requests spread to the (1-replica) pool, an
+epoch-1-pinned request (the league-seat shape) asserted BIT-EQUAL to
+local inference on that checkpoint, an unroutable pin answering the
+typed `snapshot unavailable` error, the `stats` verb's exact
+`submitted == ok + shed + errors` reconciliation, `/healthz` answered
+from the registry snapshot, and the `serve_kill_epoch` chaos drill —
+the frontend + announcer die SILENTLY mid-train, routed traffic sheds
+typed (never hangs, never unaccounted), the supervision ladder
+respawns both, and the announcer's re-register shows up as the
+registry's GENERATION BUMP before routed traffic resumes.  Artifacts
+land in this directory: train.log, metrics.jsonl with the router_*
+keys, status.json (router section post-respawn), curve_router.png.
+
+Run from the repo root:  python runs/pr18_router_smoke/probe.py
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.getcwd())  # repo root
+
+import numpy as np  # noqa: E402
+
+RUN_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def main():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from handyrl_tpu.connection import find_free_port
+    from handyrl_tpu.durability import read_verified
+    from handyrl_tpu.environment import make_env
+    from handyrl_tpu.learner import Learner
+    from handyrl_tpu.models import TPUModel
+    from handyrl_tpu.serving import ServeClient, ServeError, ShedError
+
+    work = os.path.join(RUN_DIR, "work")
+    os.makedirs(work, exist_ok=True)
+    os.chdir(work)
+    status_port = find_free_port()
+    args = {
+        "env_args": {"env": "TicTacToe"},
+        "train_args": {
+            "turn_based_training": True, "observation": False,
+            "gamma": 0.8, "forward_steps": 4, "burn_in_steps": 0,
+            "compress_steps": 4, "entropy_regularization": 0.1,
+            "entropy_regularization_decay": 0.1,
+            "update_episodes": 25, "batch_size": 8,
+            "minimum_episodes": 15, "maximum_episodes": 300,
+            "epochs": 6, "num_batchers": 1, "eval_rate": 0.1,
+            "worker": {"num_parallel": 2}, "lambda": 0.7,
+            "policy_target": "VTRACE", "value_target": "VTRACE",
+            "seed": 7, "metrics_path": "metrics.jsonl",
+            "status_port": status_port, "respawn_backoff": 0.3,
+            "serving": {"mode": "on", "port": 0},
+            # the subsystem under test: the router fronting the pool,
+            # fast cadence so the kill drill's eviction/re-register
+            # cycle fits the epoch budget
+            "router": {"mode": "on", "port": 0,
+                       "heartbeat_interval": 0.5,
+                       "heartbeat_timeout": 2.0},
+            # chaos: frontend + announcer die SILENTLY at epoch 3
+            "chaos": {"serve_kill_epoch": 3},
+        },
+        "worker_args": {"num_parallel": 2, "server_address": ""},
+    }
+
+    learner = Learner(args)
+    assert learner.serve_frontend is not None
+    assert learner.router_frontend is not None
+    assert learner.serve_announcer is not None
+    rport = learner.router_frontend.port
+    replica = learner.serve_announcer.name
+    print(f"[probe] router on :{rport} fronting frontend "
+          f":{learner.serve_frontend.port} (replica {replica!r}), "
+          f"status on :{status_port}")
+    runner = threading.Thread(target=learner.run, daemon=True)
+    runner.start()
+
+    def wait(cond, deadline, msg):
+        limit = time.monotonic() + deadline
+        while not cond():
+            assert time.monotonic() < limit, msg
+            assert runner.is_alive(), f"learner died early ({msg})"
+            time.sleep(0.1)
+
+    # the announcer registers the frontend into the pool
+    wait(lambda: learner.router_frontend.registry.pool_size() >= 1,
+         30, "replica never registered")
+    assert learner.router_frontend.registry.generation(replica) == 0
+    print("[probe] announcer registered the frontend "
+          "(pool 1, generation 0)")
+
+    # /healthz answers from the registry snapshot (no replica dial)
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{status_port}/healthz", timeout=10) as r:
+        hz = json.loads(r.read())
+    assert hz["ok"] and hz["pool_size"] == 1
+    print(f"[probe] /healthz from registry bookkeeping: {hz}")
+
+    wait(lambda: learner.model_epoch >= 2
+         and os.path.exists("models/1.ckpt"),
+         180, "epoch 2 never came")
+
+    env = make_env({"env": "TicTacToe"})
+    env.reset()
+    obs = np.asarray(env.observation(env.players()[0]))
+    batch = np.stack([obs] * 8)
+    client = ServeClient("127.0.0.1", rport, timeout=10.0)
+
+    # pinned league seat THROUGH THE ROUTER: the pin routes to the
+    # replica advertising epoch 1 (the manifest ride-along in
+    # _serving_advert) and bit-matches local inference on the ckpt
+    local = TPUModel(env.net())
+    local.params = read_verified("models/1.ckpt")["params"]
+    expect = local.inference_batch(batch, None)
+    for _ in range(60):
+        try:
+            reply = client.infer_batch(batch, epoch=1)
+            break
+        except (ShedError, ServeError):
+            time.sleep(0.2)  # advert may lag one beat / kill raced
+    else:
+        raise AssertionError("pinned request never served")
+    assert reply["epoch"] == 1
+    assert np.array_equal(np.asarray(reply["outputs"]["policy"]),
+                          np.asarray(expect["policy"]))
+    print("[probe] routed pinned epoch-1 request BIT-MATCHES local "
+          "inference on models/1.ckpt")
+
+    # a pin NOBODY advertises answers typed, through the router
+    try:
+        client.infer_batch(batch, epoch=999)
+        raise AssertionError("unroutable pin served?!")
+    except ServeError as exc:
+        assert "unavailable" in str(exc)
+        print(f"[probe] unroutable pin answered typed: {exc}")
+    except ShedError as exc:
+        # the kill drill raced us: an empty pool is pool_down
+        assert exc.reason == "pool_down"
+        print(f"[probe] unroutable pin during kill window: {exc}")
+
+    # -- the chaos drill: frontend + announcer die silently at epoch 3,
+    # the supervision ladder respawns both, and the re-register bumps
+    # the registry generation before routed traffic resumes
+    wait(lambda: learner._serve_killed, 120, "chaos kill never fired")
+    print("[probe] CHAOS landed: frontend + announcer dead, no goodbye")
+    outcomes = {"ok": 0, "shed": 0, "error": 0}
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        try:
+            reply = client.infer_batch(batch)
+            outcomes["ok"] += 1
+            if (learner.router_frontend.registry.generation(replica)
+                    or 0) >= 1:
+                break  # served again AFTER the re-register
+        except ShedError as exc:
+            assert exc.reason.startswith("pool_"), exc.reason
+            outcomes["shed"] += 1
+        except ServeError:
+            outcomes["error"] += 1
+        time.sleep(0.1)
+    gen = learner.router_frontend.registry.generation(replica)
+    assert gen is not None and gen >= 1, \
+        f"no generation bump (gen={gen}, outcomes={outcomes})"
+    assert outcomes["ok"] > 0, f"pool never served again: {outcomes}"
+    print(f"[probe] respawn observed: registry generation {gen}, "
+          f"kill-window outcomes {outcomes} (sheds all typed pool_*)")
+
+    # router-side reconciliation over everything the probe did
+    stats = client.stats()
+    assert stats["submitted"] == (stats["ok"] + stats["shed"]
+                                  + stats["errors"])
+    print(f"[probe] router stats verb reconciles: "
+          f"{stats['submitted']} submitted == {stats['ok']} ok + "
+          f"{stats['shed']} shed + {stats['errors']} errors "
+          f"(reroutes {stats['reroutes']}, pool_sheds "
+          f"{stats['pool_sheds']})")
+
+    # status endpoint: router section with the post-respawn registry
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{status_port}/", timeout=10) as r:
+        snap = json.loads(r.read())
+    assert snap["router"]["registry"]["replicas"][replica][
+        "generation"] >= 1
+    assert snap["serving"]["announcer"]["registrations"] >= 2
+    with open(os.path.join(RUN_DIR, "status.json"), "w") as f:
+        json.dump(snap, f, indent=1)
+    print("[probe] status endpoint: router section + announcer "
+          "sub-section saved (generation bump visible)")
+
+    client.close()
+    runner.join(timeout=300)
+    assert not runner.is_alive(), "learner never finished"
+    assert learner.model_epoch == 6
+    assert learner.trainer.failure is None
+    with open("metrics.jsonl") as f:
+        recs = [json.loads(line) for line in f if line.strip()]
+    assert len(recs) == 6
+    for rec in recs:
+        assert "router_requests" in rec and "router_pool_size" in rec
+        assert "reroutes" in rec and "pool_sheds" in rec
+        assert "router_respawns" in rec
+    assert sum(r["router_requests"] for r in recs) >= stats["submitted"]
+    assert sum(r["serve_respawns"] for r in recs) >= 1
+    import shutil
+
+    shutil.copy("metrics.jsonl", os.path.join(RUN_DIR, "metrics.jsonl"))
+    print("[probe] DONE: training completed, router_* keys in every "
+          "metrics record, frontend respawn counted")
+
+
+if __name__ == "__main__":
+    main()

@@ -395,6 +395,41 @@ def plot(epochs, out_prefix):
                     bbox_inches="tight")
         print(f"wrote {out_prefix}_serving.png")
 
+    # pool router (PR 18): pool membership on the right axis against
+    # the routed-request counters — an eviction shows as a pool_size
+    # drop with a reroute burst, a whole-pool breach as pool_sheds.
+    # Same series() skip-absent discipline: pre-router files plot
+    rtr_cnt_keys = [k for k in ("router_requests", "router_ok",
+                                "router_shed", "router_errors",
+                                "reroutes", "pool_sheds",
+                                "router_respawns")
+                    if any(k in e for e in epochs)]
+    rtr_pool_key = ("router_pool_size"
+                    if any("router_pool_size" in e for e in epochs)
+                    else None)
+    if rtr_cnt_keys or rtr_pool_key:
+        fig, ax = plt.subplots(figsize=(8, 5))
+        for k in rtr_cnt_keys:
+            pts = series(xs, epochs, k)
+            if pts:
+                ax.plot(*zip(*pts), label=k, marker=".")
+        ax.set_xlabel("epoch")
+        ax.set_ylabel("requests / outcomes")
+        ax2 = ax.twinx()
+        if rtr_pool_key:
+            pts = series(xs, epochs, rtr_pool_key)
+            if pts:
+                ax2.plot(*zip(*pts), label=rtr_pool_key,
+                         linestyle="--")
+        ax2.set_ylabel("routable replicas")
+        lines, labels = ax.get_legend_handles_labels()
+        lines2, labels2 = ax2.get_legend_handles_labels()
+        ax.legend(lines + lines2, labels + labels2, fontsize=8)
+        ax.grid(alpha=0.3)
+        fig.savefig(out_prefix + "_router.png", dpi=120,
+                    bbox_inches="tight")
+        print(f"wrote {out_prefix}_router.png")
+
     # generation stats (mean +- std band)
     pts = [(x, e["generation_mean"], e.get("generation_std", 0.0))
            for x, e in zip(xs, epochs) if "generation_mean" in e]

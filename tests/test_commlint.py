@@ -415,13 +415,26 @@ def test_repo_protocol_graph_is_populated():
     assert serving_plane <= set(an.handled_verbs), (
         f"serving verbs not discovered as handled: "
         f"{serving_plane - set(an.handled_verbs)}")
-    # round-trip semantics: model fetches, the shm handshake, and both
-    # serving verbs expect replies; quit is fire-and-forget by
-    # protocol (its handler breaks without a reply)
+    # the pool-routing plane (PR 18): the replica announcer sends
+    # register/beat (round trips) and drain (a goodbye) to the router,
+    # whose per-connection dispatch handles all three alongside the
+    # client-facing infer/stats
+    router_plane = {"register", "beat", "drain"}
+    assert router_plane <= set(an.sent_verbs), (
+        f"router-plane verbs not discovered as sent: "
+        f"{router_plane - set(an.sent_verbs)}")
+    assert router_plane <= set(an.handled_verbs), (
+        f"router-plane verbs not discovered as handled: "
+        f"{router_plane - set(an.handled_verbs)}")
+    # round-trip semantics: model fetches, the shm handshake, both
+    # serving verbs, and the announcer's register expect replies; quit
+    # is fire-and-forget by protocol (its handler breaks without a
+    # reply), and the router plane's drain follows the same discipline
     assert all(s.expects_reply for s in an.sent_verbs["model"])
     assert all(s.expects_reply for s in an.sent_verbs["shm"])
     assert all(s.expects_reply for s in an.sent_verbs["infer"])
     assert all(s.expects_reply for s in an.sent_verbs["stats"])
+    assert all(s.expects_reply for s in an.sent_verbs["register"])
     assert not any(s.expects_reply for s in an.sent_verbs["quit"])
     # episode/result reach their sends through Worker._ship (the
     # ship-or-spill helper between the shm transport and the control

@@ -10,7 +10,7 @@ from handyrl_tpu.anakin.config import AnakinConfig
 from handyrl_tpu.config import TrainConfig, WorkerConfig
 from handyrl_tpu.pipeline.config import PipelineConfig
 from handyrl_tpu.resilience.chaos import ChaosConfig
-from handyrl_tpu.serving.config import ServingConfig
+from handyrl_tpu.serving.config import RouterConfig, ServingConfig
 
 DOCS = os.path.join(os.path.dirname(__file__), "..", "docs",
                     "parameters.md")
@@ -39,6 +39,8 @@ def _config_keys():
         keys.add(field.name)  # the documented anakin.* sub-keys
     for field in dataclasses.fields(ServingConfig):
         keys.add(field.name)  # the documented serving.* sub-keys
+    for field in dataclasses.fields(RouterConfig):
+        keys.add(field.name)  # the documented router.* sub-keys
     keys.update({"env", "opponent"})  # env_args.env + eval.opponent
     return keys
 
