@@ -2050,10 +2050,19 @@ def _interleaved_rounds(rounds, legs):
     comparison measures drift, not the margin (the 0.26 phantom "WAL
     overhead" that motivated the discipline).  Returns
     ``{leg_name: [per-round result, ...]}``."""
+    from handyrl_tpu.analysis.guards import ResourceLedger
+
+    ledger = ResourceLedger()
     out = {name: [] for name in legs}
-    for _ in range(rounds):
+    for i in range(rounds):
+        base = ledger.sample()
         for name, run in legs.items():
             out[name].append(run())
+        # one-line fd/thread/shm delta per round to stderr: a bench
+        # round that leaks (a child's pipe end, a stranded shm ring)
+        # compounds across rounds and skews every later leg's numbers
+        print(f"round {i + 1}/{rounds} {ledger.delta_line(base)}",
+              file=sys.stderr)
     return out
 
 

@@ -56,6 +56,19 @@ knows:
     ``lock-order-cycle``/``blocking-under-lock`` rules, catching the
     interleavings the analyzer could not reach (locks passed through
     config, dynamic handler sets).
+  * :class:`ResourceLedger` samples the process's resource population
+    once per epoch — ``/proc/self/fd`` count (and how many are
+    sockets), ``threading.enumerate()`` count, and the shared-memory
+    segments visible in ``/dev/shm`` — and reports ``fd_count`` /
+    ``thread_count`` / ``shm_segments`` / ``resource_growth`` into
+    the metrics jsonl: the runtime complement of leaklint's
+    lifecycle rules, catching the leaks the analyzer could not prove
+    (handles escaping into containers, C-level fds).  Growth is
+    measured against a post-warmup baseline, so a weeks-long serving
+    replica that slowly accretes fds is visible as a rising
+    ``resource_growth`` curve long before the kernel's fd limit
+    kills it; ``max_fd_growth > 0`` turns the budget into a hard
+    :class:`ResourceError`.
 
 All are near-zero-cost (an isinstance check / an integer bump per
 event) and run armed in production: the learner feeds their per-epoch
@@ -897,3 +910,144 @@ class LockOrderGuard:
             return {"locks_guarded": len(self._names),
                     "lock_contention_sec": round(self.contention_sec, 6),
                     "lock_order_inversions": self.inversions}
+
+
+class ResourceError(RuntimeError):
+    pass
+
+
+class ResourceLedger:
+    """Per-epoch resource-population sampling (the leak soak meter).
+
+    leaklint proves from source that every acquisition has an owner
+    who releases it; this ledger measures the population that actually
+    runs — because handles escape into containers, C extensions open
+    fds Python never sees, and a suppression's "process-lifetime"
+    claim can simply be wrong.  Each :meth:`snapshot` (the learner
+    calls it once per epoch, next to the other guards) samples:
+
+      * ``fd_count`` — entries in ``/proc/self/fd``;
+      * ``thread_count`` — ``len(threading.enumerate())``;
+      * ``shm_segments`` — ``psm_*`` segments in ``/dev/shm`` (the
+        default names ``multiprocessing.shared_memory`` gives the
+        rings and boards);
+      * ``resource_growth`` — fds above the post-warmup baseline.
+
+    The first ``warmup_epochs`` snapshots are bring-up (workers
+    dialing in, rings mapping) and set the baseline at the end of the
+    window; after that, growth is measured against the baseline so a
+    slow accretion shows up as a rising ``resource_growth`` curve on
+    the same plots as the loss.  ``max_fd_growth > 0`` makes the
+    budget hard: a post-warmup snapshot whose growth exceeds it
+    raises :class:`ResourceError` (default 0 = count and report,
+    never raise — sampling must not be able to kill a healthy run).
+
+    Sampling is three directory listings per EPOCH — noise next to a
+    single update step.  On hosts without ``/proc`` the fd/socket
+    samples degrade to 0 and the ledger still reports (the keys stay
+    present so the metrics schema is stable).  The proc/shm paths are
+    injectable so leak tests can point the ledger at a fixture tree.
+    """
+
+    def __init__(self, max_fd_growth: int = 0, warmup_epochs: int = 2,
+                 proc_fd_dir: str = "/proc/self/fd",
+                 shm_dir: str = "/dev/shm"):
+        self.max_fd_growth = max(0, int(max_fd_growth or 0))
+        self.warmup_epochs = max(0, int(warmup_epochs))
+        self.proc_fd_dir = proc_fd_dir
+        self.shm_dir = shm_dir
+        self.epochs = 0
+        self.baseline = None          # (fd, threads) post-warmup
+        self.peak_growth = 0
+        self.last = None              # most recent sample dict
+        self._lock = threading.Lock()
+
+    # -- sampling ----------------------------------------------------
+    def sample(self) -> dict:
+        """One population sample (no epoch bookkeeping)."""
+        import os
+
+        try:
+            fds = os.listdir(self.proc_fd_dir)
+        except OSError:
+            fds = []
+        sockets = 0
+        for fd in fds:
+            try:
+                target = os.readlink(
+                    os.path.join(self.proc_fd_dir, fd))
+            except OSError:
+                continue
+            if target.startswith("socket:"):
+                sockets += 1
+        try:
+            shm = sum(1 for name in os.listdir(self.shm_dir)
+                      if name.startswith("psm_"))
+        except OSError:
+            shm = 0
+        return {"fd_count": len(fds),
+                "thread_count": len(threading.enumerate()),
+                "shm_segments": shm,
+                "socket_count": sockets}
+
+    def snapshot(self) -> dict:
+        """One epoch tick: sample, update the baseline/growth
+        bookkeeping, and return the metrics-jsonl keys.  Raises
+        :class:`ResourceError` only when ``max_fd_growth > 0`` and a
+        post-warmup sample exceeds the budget."""
+        sampled = self.sample()
+        with self._lock:
+            self.epochs += 1
+            self.last = sampled
+            if self.baseline is None \
+                    and self.epochs > self.warmup_epochs:
+                self.baseline = (sampled["fd_count"],
+                                 sampled["thread_count"])
+            growth = 0
+            if self.baseline is not None:
+                growth = max(0, sampled["fd_count"] - self.baseline[0])
+                self.peak_growth = max(self.peak_growth, growth)
+            budget = self.max_fd_growth
+        record = {"fd_count": sampled["fd_count"],
+                  "thread_count": sampled["thread_count"],
+                  "shm_segments": sampled["shm_segments"],
+                  "resource_growth": growth}
+        if budget and growth > budget:
+            raise ResourceError(
+                f"fd count grew by {growth} over the post-warmup "
+                f"baseline (> max_fd_growth={budget}): "
+                f"{sampled['fd_count']} fds "
+                f"({sampled['socket_count']} sockets), "
+                f"{sampled['shm_segments']} shm segments — a resource "
+                f"leak leaklint could not see; check the suppressions "
+                f"and container-held handles")
+        return record
+
+    # -- reporting ----------------------------------------------------
+    def stats(self) -> dict:
+        """Cumulative totals for the status endpoint."""
+        with self._lock:
+            last = dict(self.last) if self.last else {}
+            return {"fd_count": last.get("fd_count", 0),
+                    "thread_count": last.get("thread_count", 0),
+                    "shm_segments": last.get("shm_segments", 0),
+                    "socket_count": last.get("socket_count", 0),
+                    "baseline_fd": None if self.baseline is None
+                    else self.baseline[0],
+                    "peak_fd_growth": self.peak_growth,
+                    "max_fd_growth": self.max_fd_growth,
+                    "epochs_sampled": self.epochs}
+
+    def delta_line(self, since: dict) -> str:
+        """One-line human delta vs an earlier :meth:`sample` (bench
+        rounds log this so leak regressions show in CI artifacts)."""
+        now = self.sample()
+
+        def arrow(key):
+            a, b = since.get(key, 0), now.get(key, 0)
+            sign = f"{b - a:+d}" if b != a else "±0"
+            return f"{a}->{b} ({sign})"
+
+        return (f"resources: fd {arrow('fd_count')}, "
+                f"threads {arrow('thread_count')}, "
+                f"shm {arrow('shm_segments')}")

@@ -12,6 +12,7 @@ Usage::
     python -m handyrl_tpu.analysis.jaxlint --comm handyrl_tpu/
     python -m handyrl_tpu.analysis.jaxlint --race handyrl_tpu/
     python -m handyrl_tpu.analysis.jaxlint --num handyrl_tpu/
+    python -m handyrl_tpu.analysis.jaxlint --leak handyrl_tpu/
     python -m handyrl_tpu.analysis.jaxlint --sarif handyrl_tpu/
     python -m handyrl_tpu.analysis.jaxlint --list-rules
     handyrl-jaxlint handyrl_tpu/            # console-script entry
@@ -27,10 +28,13 @@ iteration, lock-order cycles, blocking under a lock, leaked
 acquires) and ``--num`` the dtype/precision-flow rule set
 (:mod:`.numrules` — implicit upcasts, weak-type promotion, bf16
 accumulation, unguarded lossy casts, split-brain return dtypes,
-nonfinite producers); the flags compose.  ``--sarif`` emits SARIF
+nonfinite producers) and ``--leak`` the resource-lifecycle rule set
+(:mod:`.leakrules` — unreleased/error-path-leaked locals, respawn
+overwrites, unjoined threads, unlinked shm creators, double
+releases); the flags compose.  ``--sarif`` emits SARIF
 2.1.0 for GitHub code scanning; ``--exclude`` drops path prefixes
 (e.g. test fixtures) from directory scans.  ``--list-rules`` always
-prints all five rule families.
+prints all six rule families.
 
 Exit status: 0 when clean, 1 when any finding survives suppression,
 2 on usage/IO errors.
@@ -217,11 +221,13 @@ def load_package(paths: List[str], exclude: Optional[List[str]] = None):
 def active_registry(shard: bool = False,
                     comm: bool = False,
                     race: bool = False,
-                    num: bool = False) -> Dict[str, "object"]:
+                    num: bool = False,
+                    leak: bool = False) -> Dict[str, "object"]:
     """The rule registry in force: jaxlint's base rules, plus the
     shardlint rules with ``shard=True``, the commlint rules with
-    ``comm=True``, the racelint rules with ``race=True``, and the
-    numlint rules with ``num=True`` (the flags compose)."""
+    ``comm=True``, the racelint rules with ``race=True``, the
+    numlint rules with ``num=True``, and the leaklint rules with
+    ``leak=True`` (the flags compose)."""
     registry = dict(RULES)
     if shard:
         from .shardrules import SHARD_RULES
@@ -239,6 +245,10 @@ def active_registry(shard: bool = False,
         from .numrules import NUM_RULES
 
         registry.update(NUM_RULES)
+    if leak:
+        from .leakrules import LEAK_RULES
+
+        registry.update(LEAK_RULES)
     return registry
 
 
@@ -248,6 +258,7 @@ def lint_paths(paths: List[str],
                comm: bool = False,
                race: bool = False,
                num: bool = False,
+               leak: bool = False,
                exclude: Optional[List[str]] = None) -> List[Finding]:
     """Run the (selected) rules over ``paths``; returns surviving
     findings sorted by location."""
@@ -258,7 +269,7 @@ def lint_paths(paths: List[str],
     ]
     compute_tracer_taint(package)
     compute_device_summaries(package)
-    registry = active_registry(shard, comm, race, num)
+    registry = active_registry(shard, comm, race, num, leak)
     active = [registry[r] for r in (select or sorted(registry))]
     for mod in package.modules.values():
         supp = suppressions[mod.path]
@@ -282,13 +293,14 @@ def lint_source(source: str, name: str = "<string>",
                 shard: bool = False,
                 comm: bool = False,
                 race: bool = False,
-                num: bool = False) -> List[Finding]:
+                num: bool = False,
+                leak: bool = False) -> List[Finding]:
     """Lint one in-memory module (test/fixture helper)."""
     module = ModuleInfo(name, name, source)
     package = Package([module])
     compute_tracer_taint(package)
     compute_device_summaries(package)
-    registry = active_registry(shard, comm, race, num)
+    registry = active_registry(shard, comm, race, num, leak)
     supp = Suppressions(source, name)
     findings: List[Finding] = []
     if supp.skip_file:
@@ -410,6 +422,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--num", action="store_true",
                         help="also run the dtype/precision-flow "
                              "rules (numlint)")
+    parser.add_argument("--leak", action="store_true",
+                        help="also run the resource-lifecycle/"
+                             "ownership rules (leaklint)")
     parser.add_argument("--select", default=None,
                         help="comma-separated rule ids to run "
                              "(default: all)")
@@ -422,13 +437,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = parser.parse_args(argv)
 
     registry = active_registry(args.shard, args.comm, args.race,
-                               args.num)
+                               args.num, args.leak)
     if args.list_rules:
         # the rule LISTING is documentation, not a gate: always show
-        # every registered family (jax + shard + comm + race + num)
-        # with its doc
+        # every registered family (jax + shard + comm + race + num +
+        # leak) with its doc
         _print_rules(active_registry(shard=True, comm=True, race=True,
-                                     num=True))
+                                     num=True, leak=True))
         return 0
     if args.json and args.sarif:
         print("jaxlint: --json and --sarif are mutually exclusive",
@@ -448,7 +463,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         findings = lint_paths(paths, select=select, shard=args.shard,
                               comm=args.comm, race=args.race,
-                              num=args.num, exclude=args.exclude)
+                              num=args.num, leak=args.leak,
+                              exclude=args.exclude)
     except FileNotFoundError as exc:
         print(f"jaxlint: no such path: {exc}", file=sys.stderr)
         return 2

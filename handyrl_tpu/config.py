@@ -216,6 +216,15 @@ class TrainConfig:
     # and `lock_order_inversions` in the metrics jsonl — the runtime
     # twin of racelint's lock-order-cycle rule
     lock_order_guard: bool = True
+    # arm a ResourceLedger sampling the process's resource population
+    # once per epoch: `fd_count`/`thread_count`/`shm_segments`/
+    # `resource_growth` in the metrics jsonl plus a `resources`
+    # status section — the runtime twin of leaklint's lifecycle rules
+    resource_ledger: bool = True
+    # hard fd-growth budget for the ledger: a post-warmup epoch whose
+    # fd count exceeds the baseline by more than this raises
+    # ResourceError.  0 = count and report only, never raise
+    max_fd_growth: int = 0
     # -- telemetry (handyrl_tpu.telemetry) --
     # arm span tracing + the flight recorder: trace_span sections,
     # trace-context propagation over the control plane, per-process
@@ -343,7 +352,7 @@ class TrainConfig:
                     "checkpoint_keep_every", "device_replay_mb",
                     "device_replay_episodes", "updates_per_epoch",
                     "max_update_compiles", "max_resharding_copies",
-                    "max_nonfinite_steps",
+                    "max_nonfinite_steps", "max_fd_growth",
                     "heartbeat_interval", "max_respawns",
                     "max_frame_bytes", "status_port",
                     "target_update_interval", "max_policy_lag",

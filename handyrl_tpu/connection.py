@@ -169,11 +169,9 @@ class TracedConnection:
 
 def find_free_port() -> int:
     """An OS-assigned free TCP port (tests, local multihost bring-up)."""
-    sock = socket.socket()
-    sock.bind(("127.0.0.1", 0))
-    port = sock.getsockname()[1]
-    sock.close()
-    return port
+    with socket.socket() as sock:
+        sock.bind(("127.0.0.1", 0))
+        return sock.getsockname()[1]
 
 
 def open_socket_connection(address: str, port: int, reuse=False,
@@ -197,16 +195,22 @@ def accept_socket_connections(port: int, timeout=None, backlog=128,
     bookkeeping belongs to the consumer (QueueCommunicator drops dead
     peers).  ``backlog`` only bounds the kernel's pending-accept queue."""
     server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-    server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    server.bind(("", port))
-    server.listen(backlog)
-    server.settimeout(timeout)
-    while True:
-        try:
-            sock, _ = server.accept()
-            yield FramedConnection(sock, max_frame_bytes=max_frame_bytes)
-        except socket.timeout:
-            yield None
+    try:
+        server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        server.bind(("", port))
+        server.listen(backlog)
+        server.settimeout(timeout)
+        while True:
+            try:
+                sock, _ = server.accept()
+                yield FramedConnection(
+                    sock, max_frame_bytes=max_frame_bytes)
+            except socket.timeout:
+                yield None
+    finally:
+        # runs on GeneratorExit when the consumer drops the generator:
+        # the listening socket must not outlive its accept loop
+        server.close()
 
 
 # -- multiprocessing fan-out --------------------------------------------

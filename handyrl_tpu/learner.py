@@ -47,6 +47,7 @@ from .analysis.guards import (
     HostTransferGuard,
     LockOrderGuard,
     NumericsGuard,
+    ResourceLedger,
     RetraceGuard,
     ShardingContractGuard,
     StallWatchdog,
@@ -1810,6 +1811,15 @@ class Learner:
                     (self.stall_watchdog, "_lock"),
             ):
                 self.lock_guard.arm(obj, attr)
+        # per-epoch resource-population sampling (fd/thread/shm
+        # counts + growth vs the post-warmup baseline) — the runtime
+        # twin of leaklint's lifecycle rules.  max_fd_growth > 0
+        # makes the budget a hard ResourceError
+        self.resource_ledger = None
+        if self.args.get("resource_ledger", True):
+            self.resource_ledger = ResourceLedger(
+                max_fd_growth=int(
+                    self.args.get("max_fd_growth", 0) or 0))
         # read-only live status endpoint (dashboards poll this instead
         # of touching the control plane); 0 = off
         self.status = None
@@ -1844,6 +1854,9 @@ class Learner:
         lock_guard = getattr(self, "lock_guard", None)
         if lock_guard is not None:
             snap["locks"] = lock_guard.stats()
+        ledger = getattr(self, "resource_ledger", None)
+        if ledger is not None:
+            snap["resources"] = ledger.stats()
         if self.wal is not None:
             snap["wal"] = self.wal.stats()
         trainer = getattr(self, "trainer", None)
@@ -2376,6 +2389,11 @@ class Learner:
             # runtime ABBA order inversions this epoch; steady state
             # is (~0, 0) — see analysis.guards.LockOrderGuard
             record.update(self.lock_guard.snapshot())
+        if self.resource_ledger is not None:
+            # fd/thread/shm population + growth over the post-warmup
+            # baseline; a healthy fleet PLATEAUS after bring-up — see
+            # analysis.guards.ResourceLedger
+            record.update(self.resource_ledger.snapshot())
         if self.metrics_path and self.primary:
             with open(self.metrics_path, "a") as f:
                 f.write(json.dumps(record) + "\n")

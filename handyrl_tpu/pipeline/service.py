@@ -347,6 +347,11 @@ class InferenceService:
         with self._lock:
             clients = list(self._clients.values())
             self._clients.clear()
+            # the graveyard holds reaped clients whose GRAVE_GRACE has
+            # not expired; final teardown must not strand their rings
+            # (3 shm segments each) waiting for a reaper that is gone
+            clients.extend(c for _due, c in self._grave)
+            self._grave = []
         for c in clients:
             c.req.close()
             c.rsp.close()

@@ -798,10 +798,14 @@ class WorkerServer(QueueCommunicator):
 def entry(worker_args):
     """Remote machine -> learner handshake; returns the merged config."""
     conn = open_socket_connection(worker_args["server_address"], ENTRY_PORT)
-    conn.send(worker_args)
-    # jaxlint: disable=unbounded-recv -- one-shot startup handshake, operator-visible: the learner replies immediately on accept, and a dead learner raises into _join's retry loop
-    merged = conn.recv()
-    conn.close()
+    try:
+        conn.send(worker_args)
+        # jaxlint: disable=unbounded-recv -- one-shot startup handshake, operator-visible: the learner replies immediately on accept, and a dead learner raises into _join's retry loop
+        merged = conn.recv()
+    finally:
+        # a learner dying mid-handshake raises into _join's retry
+        # loop; without this the retry loop leaks one fd per attempt
+        conn.close()
     return merged
 
 
@@ -850,10 +854,15 @@ class RemoteWorkerCluster:
             self.args["server_address"], WORKER_PORT,
             max_frame_bytes=int(merged.get("max_frame_bytes", 0)
                                 or DEFAULT_MAX_FRAME_BYTES))
-        proc = _mp.Process(
-            target=gather_loop, args=(merged, conn, slot))
-        proc.start()
-        conn.close()
+        try:
+            proc = _mp.Process(
+                target=gather_loop, args=(merged, conn, slot))
+            proc.start()
+        finally:
+            # the spawn context pickles conn at start(); the parent's
+            # copy must close whether or not the start succeeded, or
+            # every failed respawn strands a learner-facing fd
+            conn.close()
         return proc
 
     def _run_session(self, merged):

@@ -1,0 +1,81 @@
+"""PR 19 smoke drive: two-epoch TicTacToe train with the resource
+ledger armed, recorded under runs/pr19_leaklint_smoke/.
+
+Asserts the acceptance line directly: fd_count/thread_count in EVERY
+metrics record, growth within budget, and the fd/thread population
+PLATEAUED between the first and last epoch.  Then the status snapshot
+(with its `resources` section) lands in status.json; render the plots
+with scripts/plot_metrics.py (the resource series ride *_guards.png).
+"""
+
+import json
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+args = {
+    "env_args": {"env": "TicTacToe"},
+    "train_args": {
+        "turn_based_training": True,
+        "observation": False,
+        "gamma": 0.8,
+        "forward_steps": 4,
+        "burn_in_steps": 0,
+        "compress_steps": 4,
+        "entropy_regularization": 0.1,
+        "entropy_regularization_decay": 0.1,
+        "update_episodes": 15,
+        "batch_size": 4,
+        "minimum_episodes": 10,
+        "maximum_episodes": 200,
+        "epochs": 2,
+        "num_batchers": 1,
+        "eval_rate": 0.1,
+        "worker": {"num_parallel": 2},
+        "lambda": 0.7,
+        "policy_target": "VTRACE",
+        "value_target": "VTRACE",
+        "seed": 1,
+        "resource_ledger": True,
+        "max_fd_growth": 64,   # armed for real: raises past budget
+        "metrics_path": "metrics.jsonl",
+    },
+    "worker_args": {"num_parallel": 2, "server_address": ""},
+}
+
+
+def main():
+    os.chdir(HERE)
+
+    from handyrl_tpu.learner import Learner
+
+    learner = Learner(args)
+    learner.run()
+    assert learner.model_epoch == 2
+
+    with open("status.json", "w") as f:
+        json.dump(learner._status_snapshot(), f, indent=2,
+                  sort_keys=True)
+
+    with open("metrics.jsonl") as f:
+        records = [json.loads(line) for line in f if line.strip()]
+    assert len(records) == 2, records
+    for r in records:
+        assert r["fd_count"] > 0, r
+        assert r["thread_count"] >= 1, r
+        assert r["shm_segments"] >= 0, r
+        assert 0 <= r["resource_growth"] <= 64, r
+    first, last = records[0], records[-1]
+    assert last["fd_count"] - first["fd_count"] <= 4, (first, last)
+    assert last["thread_count"] - first["thread_count"] <= 2, (
+        first, last)
+
+    print("smoke OK:",
+          {k: [r[k] for r in records]
+           for k in ("fd_count", "thread_count", "shm_segments",
+                     "resource_growth")})
+
+
+if __name__ == "__main__":
+    main()

@@ -155,14 +155,19 @@ def plot(epochs, out_prefix):
     # guard counters (analysis.guards via the metrics jsonl):
     # retrace_count is cumulative and must stay FLAT after epoch 1;
     # host_transfers is the per-epoch delta and must not grow with the
-    # step count — a rising line on either is a hot-path regression
+    # step count — a rising line on either is a hot-path regression.
+    # The resource-ledger populations ride here too: fd/thread/shm
+    # counts must PLATEAU after bring-up — a staircase is a per-epoch
+    # leak compounding
     guard_keys = [k for k in ("retrace_count", "host_transfers",
                               "resharding_copies", "stall_events",
                               "lock_contention_sec",
                               "lock_order_inversions",
                               "nonfinite_steps",
                               "numerics_contract_breaks",
-                              "weak_upcasts")
+                              "weak_upcasts",
+                              "fd_count", "thread_count",
+                              "shm_segments", "resource_growth")
                   if any(k in e for e in epochs)]
     if guard_keys:
         fig, ax = plt.subplots(figsize=(8, 5))

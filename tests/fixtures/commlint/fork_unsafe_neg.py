@@ -8,7 +8,7 @@ _mp = mp.get_context("spawn")
 
 
 def spawn_after_threads(target):
-    t = threading.Thread(target=target)
+    t = threading.Thread(target=target, daemon=True)
     t.start()
     proc = _mp.Process(target=target)    # spawn context: safe
     proc.start()
@@ -18,7 +18,7 @@ def spawn_after_threads(target):
 def process_before_threads(target):
     proc = mp.Process(target=target)     # no threads exist yet
     proc.start()
-    t = threading.Thread(target=target)
+    t = threading.Thread(target=target, daemon=True)
     t.start()
     return proc
 

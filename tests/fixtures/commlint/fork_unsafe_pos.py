@@ -7,7 +7,7 @@ import threading
 
 
 def spawn_after_threads(target):
-    t = threading.Thread(target=target)
+    t = threading.Thread(target=target, daemon=True)
     t.start()
     proc = mp.Process(target=target)     # fork after threads started
     proc.start()
@@ -23,7 +23,7 @@ def fork_under_lock(target):
 
 
 def raw_fork(handler):
-    t = threading.Thread(target=handler)
+    t = threading.Thread(target=handler, daemon=True)
     t.start()
     pid = os.fork()                      # os.fork after threads
     return pid

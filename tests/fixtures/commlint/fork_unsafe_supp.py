@@ -5,7 +5,7 @@ import threading
 
 
 def spawn_after_threads(target):
-    t = threading.Thread(target=target)
+    t = threading.Thread(target=target, daemon=True)
     t.start()
     # jaxlint: disable=fork-unsafe -- the started thread holds no locks and the child execs immediately; measured safe on this platform
     proc = mp.Process(target=target)

@@ -1,0 +1,29 @@
+"""Negative: idempotent-teardown idioms — a ``= None`` between
+releases, a guard, a conditional second release, or finally — are
+legitimate and quiet."""
+
+import socket
+
+
+class Teardown:
+    def __init__(self):
+        self._sock = socket.socket()
+
+    def close(self):
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
+
+    def hard_close(self):
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            sock.close()
+
+
+def close_twice_guarded(make):
+    sock = socket.socket()
+    try:
+        make(sock)
+    finally:
+        sock.close()
+    return True

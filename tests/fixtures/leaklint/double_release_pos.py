@@ -1,0 +1,21 @@
+"""Positive: one obligation discharged twice unconditionally — the
+second close hits a possibly-recycled fd, or raises mid-teardown and
+masks the error that mattered."""
+
+import socket
+
+
+def handoff():
+    sock = socket.socket()
+    sock.close()
+    sock.close()
+    return True
+
+
+class Teardown:
+    def __init__(self):
+        self._sock = socket.socket()
+
+    def close(self):
+        self._sock.close()
+        self._sock.close()

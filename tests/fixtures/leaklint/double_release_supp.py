@@ -1,0 +1,10 @@
+"""Suppressed: a deliberate double close, explained."""
+
+import socket
+
+
+def handoff():
+    sock = socket.socket()
+    sock.close()
+    sock.close()  # jaxlint: disable=double-release -- exercising the kernel's EBADF path on purpose in this harness
+    return True

@@ -1,0 +1,25 @@
+"""Negative: the release is exception-safe (with / finally / except),
+or nothing that can raise runs between acquire and release."""
+
+import socket
+
+
+def find_free_port():
+    with socket.socket() as sock:
+        sock.bind(("127.0.0.1", 0))
+        return sock.getsockname()[1]
+
+
+def find_free_port_finally():
+    sock = socket.socket()
+    try:
+        sock.bind(("127.0.0.1", 0))
+        return sock.getsockname()[1]
+    finally:
+        sock.close()
+
+
+def open_and_drop():
+    sock = socket.socket()
+    sock.close()  # nothing risky ran while the socket was live
+    return True

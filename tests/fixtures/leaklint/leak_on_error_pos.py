@@ -1,0 +1,14 @@
+"""Positive: the release exists on the happy path, but a call between
+acquire and release can raise and skip it — the find_free_port bug
+class: the leak fires exactly under fd pressure, when bind() starts
+raising."""
+
+import socket
+
+
+def find_free_port():
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    return port

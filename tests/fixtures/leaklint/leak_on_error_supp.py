@@ -1,0 +1,11 @@
+"""Suppressed: an error-path leak accepted with a reason."""
+
+import socket
+
+
+def find_free_port():
+    sock = socket.socket()  # jaxlint: disable=leak-on-error -- bind on loopback:0 cannot fail outside fd exhaustion, at which point the process is dying anyway
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    return port

@@ -1,0 +1,41 @@
+"""Negative: every re-store of a resource attribute is disciplined —
+an ``is None`` guard, a prior release / ``= None`` / teardown
+self-call in the same function, or the entry-guard idiom where every
+in-package caller checks first (the WAL append -> _open_segment
+shape)."""
+
+import socket
+
+
+class Frontend:
+    def __init__(self):
+        self._listener = None
+
+    def ensure(self):
+        if self._listener is not None:
+            return
+        self._listener = socket.create_server(("", 9999))
+
+    def respawn(self):
+        self.teardown()
+        self._listener = socket.create_server(("", 9999))
+
+    def teardown(self):
+        listener, self._listener = self._listener, None
+        if listener is not None:
+            listener.close()
+
+
+class Wal:
+    def __init__(self, path):
+        self._path = path
+        self._f = None
+
+    def _open_segment(self):
+        self._f = open(self._path, "ab")
+
+    def append(self, rec):
+        # the entry guard: the only caller checks liveness first
+        if self._f is None:
+            self._open_segment()
+        self._f.write(rec)

@@ -1,0 +1,14 @@
+"""Positive: an attribute holding a live resource is reassigned a
+fresh one with no guard and no release — the previous incarnation's fd
+lives unreferenced until process exit (the frontend.respawn() bug
+class)."""
+
+import socket
+
+
+class Frontend:
+    def __init__(self):
+        self._listener = None
+
+    def respawn(self):
+        self._listener = socket.create_server(("", 9999))

@@ -1,0 +1,11 @@
+"""Suppressed: an intentional leak-on-respawn, explained."""
+
+import socket
+
+
+class Frontend:
+    def __init__(self):
+        self._listener = None
+
+    def respawn(self):
+        self._listener = socket.create_server(("", 9999))  # jaxlint: disable=respawn-overwrite -- the old listener is owned and closed by the accept thread it was handed to

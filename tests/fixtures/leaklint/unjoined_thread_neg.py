@@ -1,0 +1,24 @@
+"""Negative: threads are daemonic (explicit fire-and-forget), joined
+before the handle drops, or joined on the class's shutdown path."""
+
+import threading
+
+
+def run_daemon(fn):
+    worker = threading.Thread(target=fn, daemon=True)
+    worker.start()
+
+
+def run_and_wait(fn):
+    worker = threading.Thread(target=fn)
+    worker.start()
+    worker.join()
+
+
+class Pool:
+    def __init__(self, fn):
+        self._worker = threading.Thread(target=fn)
+        self._worker.start()
+
+    def stop(self):
+        self._worker.join(timeout=5)

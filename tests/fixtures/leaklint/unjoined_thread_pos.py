@@ -1,0 +1,15 @@
+"""Positive: non-daemon threads spawned and never joined — interpreter
+exit blocks in threading's shutdown handler on a worker nobody owns."""
+
+import threading
+
+
+def run_worker(fn):
+    worker = threading.Thread(target=fn)
+    worker.start()
+
+
+class Pool:
+    def __init__(self, fn):
+        self._worker = threading.Thread(target=fn)
+        self._worker.start()

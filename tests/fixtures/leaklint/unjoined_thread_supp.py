@@ -1,0 +1,8 @@
+"""Suppressed: a deliberately unjoined non-daemon thread, explained."""
+
+import threading
+
+
+def run_worker(fn):
+    worker = threading.Thread(target=fn)  # jaxlint: disable=unjoined-thread -- must outlive interpreter shutdown to flush the final batch; joined implicitly by threading._shutdown
+    worker.start()

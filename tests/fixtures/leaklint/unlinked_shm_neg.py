@@ -1,0 +1,32 @@
+"""Negative: the creator unlinks on teardown; a pure ATTACHER
+(create=True absent) owes only close() — the segment belongs to its
+creator."""
+
+from multiprocessing import shared_memory
+
+
+def scratch(size):
+    seg = shared_memory.SharedMemory(create=True, size=size)
+    try:
+        seg.buf[0] = 1
+    finally:
+        seg.close()
+        seg.unlink()
+    return True
+
+
+class Board:
+    def __init__(self, size):
+        self._seg = shared_memory.SharedMemory(create=True, size=size)
+
+    def close(self):
+        self._seg.close()
+        self._seg.unlink()
+
+
+class View:
+    def __init__(self, name):
+        self._seg = shared_memory.SharedMemory(name=name)
+
+    def close(self):
+        self._seg.close()

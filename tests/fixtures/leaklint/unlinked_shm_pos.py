@@ -1,0 +1,20 @@
+"""Positive: shared-memory CREATORS that close their mapping but never
+unlink the segment — the /dev/shm file outlives every process that
+attached (the ~66 MB-per-dead-worker bug class)."""
+
+from multiprocessing import shared_memory
+
+
+def scratch(size):
+    seg = shared_memory.SharedMemory(create=True, size=size)
+    seg.buf[0] = 1
+    seg.close()
+    return True
+
+
+class Board:
+    def __init__(self, size):
+        self._seg = shared_memory.SharedMemory(create=True, size=size)
+
+    def close(self):
+        self._seg.close()

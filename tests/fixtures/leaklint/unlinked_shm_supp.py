@@ -1,0 +1,12 @@
+"""Suppressed: a creator that intentionally leaves the segment for a
+successor process, explained."""
+
+from multiprocessing import shared_memory
+
+
+class Board:
+    def __init__(self, size):
+        self._seg = shared_memory.SharedMemory(create=True, size=size)  # jaxlint: disable=unlinked-shm -- segment is handed off across respawns; the supervisor unlinks it at fleet teardown
+
+    def close(self):
+        self._seg.close()

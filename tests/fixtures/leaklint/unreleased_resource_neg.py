@@ -1,0 +1,31 @@
+"""Negative: every local acquisition is released on every path (with /
+finally), or its close obligation is TRANSFERRED — returned to the
+caller, stored on self, or passed into a container another owner
+drains."""
+
+import socket
+
+
+def fetch_banner(host):
+    with socket.create_connection((host, 80)) as sock:
+        return sock.recv(64)
+
+
+def fetch_guarded(host):
+    sock = socket.create_connection((host, 80))
+    try:
+        return sock.recv(64)
+    finally:
+        sock.close()
+
+
+def open_conn(host):
+    sock = socket.create_connection((host, 80))
+    return sock  # ownership transferred to the caller
+
+
+class Pool:
+    def __init__(self, host):
+        self._socks = []
+        sock = socket.create_connection((host, 80))
+        self._socks.append(sock)  # the pool owns it now

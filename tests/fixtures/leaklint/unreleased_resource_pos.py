@@ -1,0 +1,20 @@
+"""Positive: a function-local socket reaches an exit still live — no
+release exists on any path, and the caller never received the handle,
+so the fd is simply gone (one per call)."""
+
+import socket
+
+
+def fetch_banner(host):
+    sock = socket.create_connection((host, 80))
+    data = sock.recv(64)
+    return data
+
+
+def probe(host, deep):
+    sock = socket.create_connection((host, 80))
+    if not deep:
+        return None  # early return sidesteps the release below
+    sock.send(b"ping")
+    sock.close()
+    return True

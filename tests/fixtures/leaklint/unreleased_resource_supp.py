@@ -1,0 +1,9 @@
+"""Suppressed: a deliberately process-lifetime socket, explained."""
+
+import socket
+
+
+def boot_beacon(host):
+    sock = socket.create_connection((host, 80))  # jaxlint: disable=unreleased-resource -- process-lifetime beacon: the OS closes it at exit by design
+    sock.send(b"up")
+    return True

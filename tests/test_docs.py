@@ -67,11 +67,12 @@ def test_docs_exist():
 
 def test_static_analysis_doc_covers_every_rule():
     """docs/static_analysis.md documents each lint rule by id — ALL
-    FIVE registries (the suppression comments reference these names,
+    SIX registries (the suppression comments reference these names,
     so the page is the rule registries' public contract).  Mechanical,
     like the parameters check above: a new rule set cannot land
     undocumented."""
     from handyrl_tpu.analysis.commrules import COMM_RULES
+    from handyrl_tpu.analysis.leakrules import LEAK_RULES
     from handyrl_tpu.analysis.numrules import NUM_RULES
     from handyrl_tpu.analysis.racerules import RACE_RULES
     from handyrl_tpu.analysis.rules import RULES
@@ -83,7 +84,7 @@ def test_static_analysis_doc_covers_every_rule():
     missing = [r
                for r in (list(RULES) + list(SHARD_RULES)
                          + list(COMM_RULES) + list(RACE_RULES)
-                         + list(NUM_RULES))
+                         + list(NUM_RULES) + list(LEAK_RULES))
                if f"`{r}`" not in text]
     assert not missing, f"rules undocumented in static_analysis.md: {missing}"
 
@@ -97,6 +98,7 @@ def test_list_rules_covers_every_registry():
 
     from handyrl_tpu.analysis.commrules import COMM_RULES
     from handyrl_tpu.analysis.jaxlint import main
+    from handyrl_tpu.analysis.leakrules import LEAK_RULES
     from handyrl_tpu.analysis.numrules import NUM_RULES
     from handyrl_tpu.analysis.racerules import RACE_RULES
     from handyrl_tpu.analysis.rules import RULES
@@ -107,7 +109,7 @@ def test_list_rules_covers_every_registry():
         assert main(["--list-rules"]) == 0
     out = buf.getvalue()
     for registry in (RULES, SHARD_RULES, COMM_RULES, RACE_RULES,
-                     NUM_RULES):
+                     NUM_RULES, LEAK_RULES):
         for rule_id, rule in registry.items():
             assert f"{rule_id}: {rule.summary}" in out, (
                 f"--list-rules missing {rule_id} (or its summary)")

@@ -4,7 +4,9 @@ transfer counting (device hits, host passes, budget, restoration),
 ShardingContractGuard resharding accounting (contract capture, copy
 counting, budget, snapshot deltas), and NumericsGuard dtype-contract +
 nonfinite-step accounting (latch, break/upcast split, off-switch,
-budget)."""
+budget) — plus ResourceLedger population sampling (stable metric keys,
+leak deltas for sockets and shm rings, the hard fd-growth budget, and
+proc-less degradation)."""
 
 import jax
 import jax.numpy as jnp
@@ -16,6 +18,8 @@ from handyrl_tpu.analysis.guards import (
     HostTransferGuard,
     NumericsError,
     NumericsGuard,
+    ResourceError,
+    ResourceLedger,
     RetraceError,
     RetraceGuard,
     ShardingContractError,
@@ -582,3 +586,102 @@ def test_numerics_guard_off_switch_is_a_true_noop():
                              "numerics_contract_breaks": 0,
                              "weak_upcasts": 0,
                              "max_nonfinite_steps": 0}
+
+
+# -- ResourceLedger ----------------------------------------------------
+
+def test_resource_ledger_snapshot_has_stable_keys():
+    ledger = ResourceLedger(warmup_epochs=0)
+    record = ledger.snapshot()
+    assert set(record) == {"fd_count", "thread_count",
+                           "shm_segments", "resource_growth"}
+    assert record["fd_count"] > 0        # this process has open fds
+    assert record["thread_count"] >= 1
+
+
+def test_resource_ledger_leaked_socket_trips_the_delta():
+    """A deliberately leaked socket shows up as fd growth over the
+    post-warmup baseline — the soak meter the static rules cannot
+    replace (handles escaping into containers)."""
+    import socket
+
+    ledger = ResourceLedger(warmup_epochs=1)
+    ledger.snapshot()                    # warmup
+    ledger.snapshot()                    # sets the baseline
+    leaked = [socket.socket() for _ in range(4)]
+    try:
+        record = ledger.snapshot()
+        assert record["resource_growth"] >= 4
+        assert ledger.stats()["peak_fd_growth"] >= 4
+    finally:
+        for s in leaked:
+            s.close()
+    # releasing the leak brings growth back inside the budget
+    assert ledger.snapshot()["resource_growth"] <= 1
+
+
+def test_resource_ledger_leaked_ring_trips_shm_count():
+    """A leaked ShmRing is visible in the /dev/shm segment sample."""
+    from handyrl_tpu.pipeline.shm import ShmRing
+
+    ledger = ResourceLedger(warmup_epochs=0)
+    before = ledger.snapshot()["shm_segments"]
+    ring = ShmRing.create(slots=2, slot_bytes=128)
+    try:
+        assert ledger.snapshot()["shm_segments"] == before + 1
+    finally:
+        ring.close()
+    assert ledger.snapshot()["shm_segments"] == before
+
+
+def test_resource_ledger_budget_raises_past_max_fd_growth():
+    import socket
+
+    ledger = ResourceLedger(max_fd_growth=2, warmup_epochs=0)
+    ledger.snapshot()                    # baseline
+    leaked = [socket.socket() for _ in range(4)]
+    try:
+        with pytest.raises(ResourceError):
+            ledger.snapshot()
+    finally:
+        for s in leaked:
+            s.close()
+
+
+def test_resource_ledger_default_budget_never_raises():
+    import socket
+
+    ledger = ResourceLedger(warmup_epochs=0)
+    ledger.snapshot()
+    leaked = [socket.socket() for _ in range(8)]
+    try:
+        record = ledger.snapshot()       # counts, does not raise
+        assert record["resource_growth"] >= 8
+    finally:
+        for s in leaked:
+            s.close()
+
+
+def test_resource_ledger_degrades_without_proc(tmp_path):
+    """On hosts without /proc the keys stay present (schema stability)
+    and the fd samples degrade to 0."""
+    ledger = ResourceLedger(proc_fd_dir=str(tmp_path / "nope"),
+                            shm_dir=str(tmp_path / "nope"))
+    record = ledger.snapshot()
+    assert record["fd_count"] == 0
+    assert record["shm_segments"] == 0
+    assert record["thread_count"] >= 1
+
+
+def test_resource_ledger_delta_line_reports_movement():
+    import socket
+
+    ledger = ResourceLedger()
+    base = ledger.sample()
+    sock = socket.socket()
+    try:
+        line = ledger.delta_line(base)
+    finally:
+        sock.close()
+    assert line.startswith("resources: fd ")
+    assert "(+1)" in line

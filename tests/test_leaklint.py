@@ -1,0 +1,377 @@
+"""leaklint rule suite: every resource-lifecycle rule fires on its
+positive fixture, stays quiet on its negative, and obeys suppression
+comments — plus the acquisition/ownership machinery (the constructor-
+wrapper fixpoint, escape-transfer lattice, with/closing discharge,
+pending-exit try/finally coverage, the entry-guard exemption), the
+unified-CLI surface (--leak), and the repo gate: the shipped package
+must leak-lint clean WITH the acquisition graph verifiably populated
+(the real owners — ShmRing's raw segment, the serving frontend's
+listener socket, the supervised gather processes — must be
+discovered, or the gate would be vacuously green).
+
+Fixture convention (tests/fixtures/leaklint/): ``<rule>_pos.py`` must
+produce findings of exactly that rule under the base+leak rule set,
+``<rule>_neg.py`` and ``<rule>_supp.py`` must produce none (driver
+shared with the other suites: tests/lintfix.py).  The fixtures are
+parsed, never imported."""
+
+import json
+import os
+
+import pytest
+from lintfix import check_fixture, fixture_path
+
+from handyrl_tpu.analysis.astutil import ModuleInfo, Package
+from handyrl_tpu.analysis.commrules import COMM_RULES
+from handyrl_tpu.analysis.jaxlint import (
+    active_registry,
+    lint_paths,
+    lint_source,
+    load_package,
+    main,
+)
+from handyrl_tpu.analysis.leaklint import analyze_leaks
+from handyrl_tpu.analysis.leakrules import LEAK_RULES
+from handyrl_tpu.analysis.numrules import NUM_RULES
+from handyrl_tpu.analysis.racerules import RACE_RULES
+from handyrl_tpu.analysis.rules import RULES
+from handyrl_tpu.analysis.shardrules import SHARD_RULES
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures",
+                        "leaklint")
+REPO_PACKAGE = os.path.join(
+    os.path.dirname(__file__), "..", "handyrl_tpu")
+
+RULE_IDS = sorted(LEAK_RULES)
+
+
+def fixture(rule_id, kind):
+    return fixture_path("leaklint", rule_id, kind)
+
+
+def _analyze(src):
+    package = Package([ModuleInfo("m", "m", src)])
+    return analyze_leaks(package)
+
+
+@pytest.mark.parametrize("kind", ["pos", "neg", "supp"])
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_rule_fixture(rule_id, kind):
+    check_fixture("leaklint", rule_id, kind, leak=True)
+
+
+def test_leak_registry_is_exactly_the_issue_rule_set():
+    assert set(RULE_IDS) == {
+        "unreleased-resource", "leak-on-error", "respawn-overwrite",
+        "unjoined-thread", "unlinked-shm", "double-release"}
+
+
+def test_registries_do_not_collide():
+    # one suppression namespace across all six families
+    for other in (RULES, SHARD_RULES, COMM_RULES, RACE_RULES,
+                  NUM_RULES):
+        assert not set(LEAK_RULES) & set(other)
+    combined = active_registry(shard=True, comm=True, race=True,
+                               num=True, leak=True)
+    assert set(combined) == (set(RULES) | set(SHARD_RULES)
+                             | set(COMM_RULES) | set(RACE_RULES)
+                             | set(NUM_RULES) | set(LEAK_RULES))
+
+
+def test_other_family_fixtures_stay_quiet_under_leak_rules():
+    """The sibling families' fixtures must not trip the leak rules:
+    the six families stay independently testable."""
+    for family in ("jaxlint", "shardlint", "commlint", "racelint",
+                   "numlint"):
+        tree = os.path.join(os.path.dirname(__file__), "fixtures",
+                            family)
+        findings = lint_paths([tree], leak=True,
+                              select=sorted(LEAK_RULES))
+        assert findings == [], (
+            f"leak rules fired on {family} fixtures: "
+            f"{[(f.rule, f.path, f.line) for f in findings]}")
+
+
+def test_leak_fixtures_stay_quiet_under_race_rules():
+    findings = lint_paths([FIXTURES], race=True,
+                          select=sorted(RACE_RULES))
+    assert findings == [], (
+        f"race rules fired on leak fixtures: "
+        f"{[(f.rule, f.path, f.line) for f in findings]}")
+
+
+# -- acquisition / ownership machinery ---------------------------------
+
+def test_constructor_wrapper_fixpoint():
+    """A function returning a fresh resource becomes a constructor at
+    its call sites — the commlint send-wrapper idiom applied to
+    open_socket_connection-style helpers."""
+    src = (
+        "import socket\n\n"
+        "def open_conn(host):\n"
+        "    sock = socket.create_connection((host, 80))\n"
+        "    return sock\n\n"
+        "def dial_twice(host):\n"
+        "    return open_conn(host)\n\n"
+        "def use(host):\n"
+        "    conn = dial_twice(host)\n"
+        "    conn.send(b'x')\n")
+    an = _analyze(src)
+    kinds = {fn.qname: k for fn, k in an.returns_kind.items()}
+    assert kinds.get("m:open_conn") == "socket"
+    assert kinds.get("m:dial_twice") == "socket"   # two hops deep
+    acq = [a for a in an.acqs if a.fn.qname == "m:use"]
+    assert acq and acq[0].kind == "socket" and acq[0].name == "conn"
+    # and the rule fires through the wrapper
+    findings = lint_source(src, leak=True,
+                           select=["unreleased-resource"])
+    assert [f.line for f in findings] == [11]
+
+
+def test_escape_transfers_the_obligation():
+    """Returned, yielded, self-stored, container-stored, or passed-on
+    resources have a new owner: no local finding."""
+    src = (
+        "import socket\n\n"
+        "def ret(host):\n"
+        "    sock = socket.create_connection((host, 80))\n"
+        "    return sock\n\n"
+        "def tup(host):\n"
+        "    sock = socket.create_connection((host, 80))\n"
+        "    return ('tag', sock)\n\n"
+        "def passed(host, registry):\n"
+        "    sock = socket.create_connection((host, 80))\n"
+        "    registry.adopt(sock)\n\n"
+        "def stored(host, pool):\n"
+        "    sock = socket.create_connection((host, 80))\n"
+        "    pool[host] = sock\n")
+    an = _analyze(src)
+    assert all(a.escaped for a in an.acqs), (
+        [(a.fn.qname, a.escaped) for a in an.acqs])
+    assert lint_source(src, leak=True,
+                       select=sorted(LEAK_RULES)) == []
+
+
+def test_reading_a_live_resource_is_not_an_escape():
+    """`sock.fileno()` or an f-string mention moves no ownership: the
+    leak still fires."""
+    src = (
+        "import socket\n\n"
+        "def peek(host):\n"
+        "    sock = socket.create_connection((host, 80))\n"
+        "    fd = sock.fileno()\n"
+        "    return fd\n")
+    findings = lint_source(src, leak=True,
+                           select=["unreleased-resource"])
+    assert [f.line for f in findings] == [4]
+
+
+def test_finally_release_covers_returns_inside_try():
+    """A return inside try is covered by the finally release of ITS
+    try — but not by a finally that cannot run for that exit."""
+    src = (
+        "import socket\n\n"
+        "def covered(host):\n"
+        "    sock = socket.create_connection((host, 80))\n"
+        "    try:\n"
+        "        return sock.recv(4)\n"
+        "    finally:\n"
+        "        sock.close()\n\n"
+        "def uncovered(host):\n"
+        "    sock = socket.create_connection((host, 80))\n"
+        "    if host:\n"
+        "        return None\n"
+        "    try:\n"
+        "        return sock.recv(4)\n"
+        "    finally:\n"
+        "        sock.close()\n")
+    an = _analyze(src)
+    by_fn = {a.fn.qname: a for a in an.acqs}
+    assert by_fn["m:covered"].leak_exits == []
+    assert by_fn["m:uncovered"].leak_exits == [13]
+
+
+def test_contextlib_closing_discharges_the_obligation():
+    src = (
+        "import contextlib\n"
+        "import socket\n\n"
+        "def fetch(host):\n"
+        "    sock = socket.create_connection((host, 80))\n"
+        "    with contextlib.closing(sock):\n"
+        "        return sock.recv(4)\n")
+    assert lint_source(src, leak=True,
+                       select=sorted(LEAK_RULES)) == []
+
+
+def test_daemon_spawns_carry_no_obligation():
+    """daemon=True threads/processes are fire-and-forget by contract:
+    dropping the handle is the supported shutdown idiom."""
+    src = (
+        "import multiprocessing as mp\n"
+        "import threading\n\n"
+        "def spawn(fn):\n"
+        "    t = threading.Thread(target=fn, daemon=True)\n"
+        "    t.start()\n"
+        "    p = mp.Process(target=fn, daemon=True)\n"
+        "    p.start()\n")
+    assert lint_source(src, leak=True,
+                       select=sorted(LEAK_RULES)) == []
+
+
+def test_entry_guard_exempts_the_wal_shape():
+    """An unguarded in-function re-store is fine when EVERY in-package
+    caller guards first (append -> _open_segment)."""
+    src = (
+        "class Wal:\n"
+        "    def __init__(self, path):\n"
+        "        self._path = path\n"
+        "        self._f = None\n\n"
+        "    def _open_segment(self):\n"
+        "        self._f = open(self._path, 'ab')\n\n"
+        "    def append(self, rec):\n"
+        "        if self._f is None:\n"
+        "            self._open_segment()\n"
+        "        self._f.write(rec)\n")
+    an = _analyze(src)
+    stores = an.attr_stores[("Wal", "_f")]
+    assert stores and all(st.guarded for st in stores)
+    # remove the caller's guard and the store is naked again
+    naked = src.replace("        if self._f is None:\n"
+                        "            self._open_segment()\n",
+                        "        self._open_segment()\n")
+    an2 = _analyze(naked)
+    assert not all(st.guarded
+                   for st in an2.attr_stores[("Wal", "_f")])
+
+
+def test_teardown_self_call_releases_transitively():
+    """respawn() -> teardown() closing the listener counts as the
+    release discipline for the re-store (the release-summary
+    closure)."""
+    src = (
+        "import socket\n\n"
+        "class Frontend:\n"
+        "    def __init__(self):\n"
+        "        self._listener = None\n\n"
+        "    def respawn(self):\n"
+        "        self.teardown()\n"
+        "        self._listener = socket.create_server(('', 1))\n\n"
+        "    def teardown(self):\n"
+        "        listener, self._listener = self._listener, None\n"
+        "        if listener is not None:\n"
+        "            listener.close()\n")
+    an = _analyze(src)
+    respawn = [fn for fn in an.releases_attrs
+               if fn.qname == "m:Frontend.respawn"]
+    assert respawn and "_listener" in an.releases_attrs[respawn[0]]
+    assert all(st.guarded
+               for st in an.attr_stores[("Frontend", "_listener")])
+
+
+# -- unified CLI -------------------------------------------------------
+
+def test_cli_leak_flag_runs_leak_rules(capsys):
+    rc = main(["--leak", "--json", fixture("leak-on-error", "pos")])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["findings"]
+    assert all(f["rule"] == "leak-on-error" for f in out["findings"])
+
+
+def test_cli_without_leak_flag_skips_leak_rules(capsys):
+    rc = main([fixture("leak-on-error", "pos")])
+    assert rc == 0
+    assert "clean" in capsys.readouterr().out
+
+
+def test_cli_leak_composes_with_the_other_families(capsys):
+    rc = main(["--leak", "--shard", "--comm", "--race", "--num",
+               "--json", fixture("unlinked-shm", "pos")])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert all(f["rule"] == "unlinked-shm" for f in out["findings"])
+
+
+def test_cli_list_rules_shows_leak_family_without_flag(capsys):
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule_id in sorted(LEAK_RULES):
+        assert rule_id in out
+
+
+def test_cli_select_accepts_leak_rules_only_with_flag(capsys):
+    assert main(["--select", "unlinked-shm", FIXTURES]) == 2
+    capsys.readouterr()
+    rc = main(["--leak", "--select", "unlinked-shm",
+               fixture("unlinked-shm", "pos")])
+    assert rc == 1
+
+
+def test_cli_sarif_includes_leak_rules(capsys):
+    rc = main(["--leak", "--sarif",
+               fixture("respawn-overwrite", "pos")])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    rule_ids = {r["id"]
+                for r in doc["runs"][0]["tool"]["driver"]["rules"]}
+    assert set(LEAK_RULES) <= rule_ids
+
+
+# -- repo gate ---------------------------------------------------------
+
+def test_repo_leaklints_clean():
+    """The CI gate, enforced locally too: the shipped package must
+    have zero unsuppressed findings under the base+leak rule set."""
+    findings = lint_paths([REPO_PACKAGE], leak=True)
+    assert findings == [], "\n".join(
+        f"{f.location}: [{f.rule}] {f.message}" for f in findings)
+
+
+def test_repo_all_six_families_clean():
+    findings = lint_paths([REPO_PACKAGE], shard=True, comm=True,
+                          race=True, num=True, leak=True)
+    assert findings == [], "\n".join(
+        f"{f.location}: [{f.rule}] {f.message}" for f in findings)
+
+
+def test_repo_acquisition_graph_is_populated():
+    """The gate above is only meaningful if the analyzer actually SEES
+    the fleet's resources: the known owners must be discovered, or a
+    refactor that hides the constructors would silently disable every
+    rule."""
+    package, _, errors = load_package([REPO_PACKAGE])
+    assert errors == []
+    an = analyze_leaks(package)
+
+    # ShmRing.create's raw segment: a creator (create=True) whose
+    # obligation transfers into the ring object it constructs
+    ring_acqs = [a for a in an.acqs
+                 if a.fn.qname ==
+                 "handyrl_tpu.pipeline.shm:ShmRing.create"
+                 and a.kind == "shm"]
+    assert ring_acqs and all(a.shm_create and a.escaped
+                             for a in ring_acqs)
+
+    # the serving frontend's listener socket lives on self._listener,
+    # store guarded by the is-None discipline _ensure_listener keeps
+    stores = an.attr_stores.get(("ServingFrontend", "_listener"), [])
+    assert stores and all(st.kind == "socket" and st.guarded
+                          for st in stores)
+    # ... and the teardown path releases it (swap/clear/close events)
+    assert an.attr_events.get(("ServingFrontend", "_listener"))
+
+    # the wrapper fixpoint summarizes the repo's own constructors
+    kinds = {fn.qname: k for fn, k in an.returns_kind.items()}
+    assert kinds.get(
+        "handyrl_tpu.connection:open_socket_connection") == "conn"
+    assert kinds.get(
+        "handyrl_tpu.resilience.guardian:_spawn_process") == "process"
+
+    # the supervised gather child: a non-daemon process whose handle
+    # escapes via return into the Supervisor's child slot
+    gathers = [a for a in an.acqs
+               if a.fn.qname ==
+               "handyrl_tpu.worker:WorkerCluster._spawn_gather"
+               and a.kind == "process"]
+    assert gathers and all(not a.daemon and a.escaped
+                           for a in gathers)

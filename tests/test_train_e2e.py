@@ -62,6 +62,10 @@ def test_local_training_two_epochs(tmp_path, monkeypatch):
             # loss/grad-norm finiteness flag must stay 0 every step
             "numerics_guard": True,
             "max_nonfinite_steps": 1,
+            # resource ledger armed for real: every epoch record must
+            # carry the fd/thread/shm population, and the fleet must
+            # PLATEAU after bring-up (the soak assert below)
+            "resource_ledger": True,
             "metrics_path": "metrics.jsonl",
             # telemetry armed at the DEFAULT sample rate: the pipeline
             # metrics must land in every epoch record, and the span
@@ -150,6 +154,27 @@ def test_local_training_two_epochs(tmp_path, monkeypatch):
         # (The multichip dry-run script pins the per-geometry count
         # exactly on a deterministic synchronous dispatch.)
         assert 0 <= record["infer_compiles"] <= 4
+        # the resource ledger samples every epoch: the population
+        # keys are present in EVERY record (schema stability for the
+        # plots and the soak assert below)
+        assert record["fd_count"] > 0
+        assert record["thread_count"] >= 1
+        assert record["shm_segments"] >= 0
+        assert record["resource_growth"] >= 0
+
+    # soak: the fleet's resource population PLATEAUS — the last
+    # epoch's fd/thread counts stay within a small churn margin of
+    # epoch 1 (workers connect during bring-up, so growth is measured
+    # epoch-to-epoch, not from zero).  A leak on any per-epoch path
+    # (snapshot serving, batcher restarts, eval spawns) compounds and
+    # fails here
+    first, last = records[0], records[-1]
+    assert last["fd_count"] - first["fd_count"] <= 4, (
+        f"fd count grew {first['fd_count']} -> {last['fd_count']} "
+        f"across epochs: a per-epoch leak")
+    assert last["thread_count"] - first["thread_count"] <= 2, (
+        f"thread count grew {first['thread_count']} -> "
+        f"{last['thread_count']} across epochs")
 
     # the run's span logs export to a Perfetto trace whose propagated
     # ids cross at least two processes (worker rollouts -> learner
