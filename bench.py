@@ -41,16 +41,11 @@ BATCH = 256
 SEED_EPS = 32          # distinct self-play episodes behind the batch
 R1_GEOMETRY_BATCH = 64
 
-# bf16 peak TFLOP/s per chip by device kind (public specs); used only
-# for the MFU estimate.  Unknown kinds fall back to None -> mfu omitted.
-PEAK_TFLOPS = {
-    "TPU v4": 275.0,
-    "TPU v5": 459.0,
-    "TPU v5 lite": 197.0,
-    "TPU v5e": 197.0,
-    "TPU v6 lite": 918.0,
-    "TPU v6e": 918.0,
-}
+# per-device-kind peaks live in ONE place now — the runtime cost model
+# (telemetry.costmodel.DEVICE_PEAKS); bench's achieved-TFLOPs/MFU
+# estimate rides the same reduction, so the offline numbers and the
+# runtime metric can never disagree.  Unknown kinds -> mfu omitted.
+from handyrl_tpu.telemetry.costmodel import mfu_extras  # noqa: E402
 
 
 def _tile(batch, reps):
@@ -1660,7 +1655,7 @@ def measure_width_sweep(seed, widths=(32, 64, 128, 256),
     env.reset()
     obs0 = env.observation(env.players()[0])
     _, cells = batch_geometry(_tile(seed_batch, batch_size // SEED_EPS))
-    peak = PEAK_TFLOPS.get(jax.devices()[0].device_kind)
+    kind = jax.devices()[0].device_kind
 
     sweep = {}
     for width in widths:
@@ -1671,13 +1666,15 @@ def measure_width_sweep(seed, widths=(32, 64, 128, 256),
             iters=12, host_iters=0, timed_iters=5)
         flops_step = 3.0 * batch_size * cfg["forward_steps"] \
             * model_flops_per_sample(model.params, cells)
+        # achieved-TFLOPs/MFU math shared with the runtime cost model
+        perf = mfu_extras(flops_step, sps, kind=kind)
         entry = {
             "steps_per_sec": round(sps, 2),
             "step_time_ms_blocked": round(step_ms, 2),
-            "tflops_est": round(flops_step * sps / 1e12, 2),
+            "tflops_est": perf["achieved_tflops_est"],
         }
-        if peak:
-            entry["mfu"] = round(flops_step * sps / 1e12 / peak, 4)
+        if "mfu_measured" in perf:
+            entry["mfu"] = perf["mfu_measured"]
         sweep[str(width)] = entry
     return sweep
 
@@ -2231,13 +2228,10 @@ def main():
     # tunneled dev hosts that is dominated by tunnel RTT, not compute)
     extras["step_time_ms_pipelined"] = round(1e3 / sps_bf16, 3)
     extras["step_time_ms_blocked_incl_sync"] = round(step_ms, 3)
-    achieved = flops_step * sps_bf16 / 1e12
-    extras["achieved_tflops_est"] = round(achieved, 2)
     kind = jax.devices()[0].device_kind
     extras["device_kind"] = kind
-    peak = PEAK_TFLOPS.get(kind)
-    if peak:
-        extras["mfu_measured"] = round(achieved / peak, 4)
+    # achieved-TFLOPs/MFU math shared with the runtime cost model
+    extras.update(mfu_extras(flops_step, sps_bf16, kind=kind))
 
     # MFU vs model width: VERDICT r3 asked whether the low headline MFU
     # is intrinsic to the 32-filter flagship net — sweep and see

@@ -123,9 +123,10 @@ class _GuardedJit:
     WARM_CALLS = 64
     SAMPLE_EVERY = 8
 
-    def __init__(self, guard, fn):
+    def __init__(self, guard, fn, label=None):
         self._guard = guard
         self._fn = fn
+        self._label = label or guard.name
         self._signatures = set()
         self._calls = 0
 
@@ -141,7 +142,23 @@ class _GuardedJit:
         if (self._calls <= self.WARM_CALLS
                 or self._calls % self.SAMPLE_EVERY == 0):
             # signature BEFORE the call: donated args are dead after
-            self._signatures.add(self._signature(args, kwargs))
+            sig = self._signature(args, kwargs)
+            if sig not in self._signatures:
+                self._signatures.add(sig)
+                # a NEW signature is (to within the sampling trade
+                # above) a fresh compile: the guard's on_compile hook
+                # fires here, BEFORE the call executes, because the
+                # abstract lowering a cost-analysis harvest needs is
+                # only safe while donated argument buffers are alive.
+                # Injected rather than imported, like StallWatchdog's
+                # on_stall: analysis stays standalone
+                hook = self._guard.on_compile
+                if hook is not None:
+                    try:
+                        hook(self._label, self._fn, args, kwargs)
+                    except Exception as exc:  # must not kill the step
+                        print("WARNING: on_compile hook failed "
+                              f"({exc!r})")
         out = self._fn(*args, **kwargs)
         self._guard._after_call()
         return out
@@ -185,10 +202,18 @@ class RetraceGuard:
         self.name = name
         self.calls = 0
         self._wrapped = []
+        # called once per NEWLY seen abstract signature with
+        # (label, fn, args, kwargs), BEFORE the call runs — the
+        # telemetry cost model hooks its ``compiled.cost_analysis()``
+        # harvest here.  Injected rather than imported (the
+        # StallWatchdog.on_stall pattern): analysis stays standalone
+        self.on_compile = None
 
-    def wrap(self, fn):
-        """Wrap a jitted callable; returns the counting proxy."""
-        proxy = _GuardedJit(self, fn)
+    def wrap(self, fn, label=None):
+        """Wrap a jitted callable; returns the counting proxy.
+        ``label`` names the program for the on_compile hook (defaults
+        to the guard's name)."""
+        proxy = _GuardedJit(self, fn, label=label)
         self._wrapped.append(proxy)
         return proxy
 

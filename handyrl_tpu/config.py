@@ -330,6 +330,16 @@ class TrainConfig:
     # exact importance weights — the recorded behavior probs are the
     # past policy's.
     generation_opponent: Dict[str, Any] = field(default_factory=dict)
+    # -- perf attribution (handyrl_tpu.telemetry.costmodel) --
+    # runtime MFU/roofline cost accounting over the guarded jit
+    # programs.  Keys (validated through PerfConfig.from_config):
+    # peak_tflops / peak_hbm_gbs (override the per-device-kind peak
+    # table — how CPU hosts and unlisted accelerators get real MFU
+    # numbers) and cost_analysis (harvest XLA flops/bytes at each new
+    # guarded-program signature; default on).  Empty = table lookup by
+    # device kind.  See "Attribution & roofline" in
+    # docs/observability.md
+    perf: Dict[str, Any] = field(default_factory=dict)
 
     def __post_init__(self):
         if self.policy_target not in POLICY_TARGETS:
@@ -433,6 +443,11 @@ class TrainConfig:
                 "anakin mode needs updates_per_epoch > 0 — the fused "
                 "loop makes its own data, so the epoch cadence is the "
                 "trainer's step count, not episode intake")
+        # perf keys validate through the dataclass the cost model runs
+        # with (jax-free import: the peak table only)
+        from .telemetry.costmodel import PerfConfig
+
+        PerfConfig.from_config(self.perf)
         if self.device_replay not in ("auto", "on", "off"):
             raise ValueError(
                 f"unknown device_replay {self.device_replay!r}")

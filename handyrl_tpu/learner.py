@@ -444,6 +444,18 @@ class Trainer:
         self.retrace_guard = RetraceGuard(
             max_compiles=self.args.get("max_update_compiles", 0),
             name="update_step")
+        # runtime MFU/roofline accounting (telemetry.costmodel): the
+        # guard's on_compile hook harvests XLA's own flops/bytes for
+        # each step program at its (rare) new-signature moments, and
+        # train() reduces them into per-epoch mfu/achieved_tflops/
+        # roofline keys next to the guard counters — every run, not
+        # just bench
+        from .telemetry.costmodel import CostModel, PerfConfig
+
+        self.costmodel = CostModel(
+            PerfConfig.from_config(self.args.get("perf") or {}))
+        self.retrace_guard.on_compile = self.costmodel.on_compile
+        self._step_label = "update_step"  # the active step program
         self.transfer_guard = (
             HostTransferGuard()
             if self.args.get("host_transfer_guard", True) else None)
@@ -483,7 +495,8 @@ class Trainer:
                 self.target_params = jax.tree.map(np.asarray, self.params)
             self.update_step = self.retrace_guard.wrap(
                 self._wrap_sharding(self._wrap_numerics(
-                    self._build_update_step())))
+                    self._build_update_step())),
+                label="update_step")
             self._maybe_restore_train_state()
             if self.multihost:
                 self._sync_initial_state()
@@ -522,7 +535,9 @@ class Trainer:
                     batch_size=self.args["batch_size"],
                     mesh=self.train_mesh, params=self.params,
                     fsdp=self.train_fsdp,
-                    seed=self.args.get("seed", 0)))))
+                    seed=self.args.get("seed", 0)))),
+                label="replay_step")
+            self._step_label = "replay_step"
         # the host batcher farm exists only when the device-resident
         # path is off: skipping it frees host cores for actors
         self.batcher = None
@@ -586,7 +601,9 @@ class Trainer:
             return
         self._anakin_step = self.retrace_guard.wrap(
             self._wrap_sharding(self._wrap_numerics(
-                self.anakin.make_fused_step())))
+                self.anakin.make_fused_step())),
+            label="anakin_step")
+        self._step_label = "anakin_step"
         # the carry folds the resumed step count into its PRNG stream,
         # so a restart continues on fresh data deterministically
         self.anakin_carry = self.anakin.init_carry(self.steps)
@@ -1214,6 +1231,14 @@ class Trainer:
         self.last_metrics["device_step_sec"] = \
             prof.get("update", {}).get("sec", 0.0)
         self.last_metrics["queue_depth"] = self._queue_depth()
+        # roofline/MFU keys (telemetry.costmodel): the harvested step
+        # program's flops over this epoch's device-step seconds,
+        # against the device's peak table (or the perf.* overrides).
+        # Always present — None (JSON null) when the device kind is
+        # unknown and no override is set, so the schema stays stable
+        self.last_metrics.update(self.costmodel.epoch_metrics(
+            self._step_label,
+            self.last_metrics["device_step_sec"], batch_cnt))
         # guard counters (see analysis.guards): the compile count is
         # cumulative and must stay flat after the first epoch; host
         # transfers are the per-epoch delta and must not grow with
@@ -1548,6 +1573,12 @@ class Learner:
         # checkpoint + WAL seal inside the grace window), THEN the
         # flight-recorder dump and exit
         telemetry.install_signal_dump(pre_dump=self._preempt_save)
+        # per-epoch self-time attribution over the span ring; the last
+        # snapshot rides every flight-recorder dump so a crash leaves
+        # its time-attribution next to its timeline
+        self.attributor = telemetry.Attributor()
+        telemetry.register_dump_extra(
+            "attribution", lambda: self.attributor.last)
         self._run_t0 = time.monotonic()
         self._epoch_t = self._run_t0
         self._policy_lags = []        # episode lags consumed this epoch
@@ -1694,6 +1725,15 @@ class Learner:
                 mesh=infer_mesh, fsdp=self.trainer.train_fsdp,
                 max_reshard=int(
                     self.args.get("max_resharding_copies", 0) or 0))
+            # the inference guard shares the trainer's cost model: its
+            # forward program lands in the same registry under its own
+            # label.  Attached on the guard (which respawn() reuses),
+            # so the hook survives chaos-drill service respawns.  The
+            # ASYNC hook: a blocking AOT compile in the batching
+            # thread stalls replies past the workers' timeout and they
+            # degrade to local inference for good
+            self.infer_service.retrace_guard.on_compile = \
+                self.trainer.costmodel.on_compile_async
             self.infer_service.start()
         # network serving tier (handyrl_tpu.serving): a framed TCP
         # frontend whose remote requests join the inference service's
@@ -1860,6 +1900,13 @@ class Learner:
         if self.wal is not None:
             snap["wal"] = self.wal.stats()
         trainer = getattr(self, "trainer", None)
+        costmodel = getattr(trainer, "costmodel", None)
+        if costmodel is not None:
+            # roofline accounting + the last epoch's self-time tree
+            # (docs/observability.md "Attribution & roofline")
+            perf = costmodel.stats()
+            perf["attribution"] = self.attributor.last
+            snap["perf"] = perf
         num_guard = getattr(trainer, "num_guard", None)
         if num_guard is not None:
             snap["numerics"] = num_guard.stats()
@@ -2394,6 +2441,16 @@ class Learner:
             # baseline; a healthy fleet PLATEAUS after bring-up — see
             # analysis.guards.ResourceLedger
             record.update(self.resource_ledger.snapshot())
+        # wall-time reconciliation (telemetry.attribution): the residual
+        # is DEFINED over the record's own rounded values, so
+        # epoch_wall_sec == sum(profile_*_sec) + untracked_residual_sec
+        # holds exactly in every emitted record; slightly negative =
+        # trainer-thread sections vs learner-thread wall window skew
+        record["untracked_residual_sec"] = \
+            telemetry.untracked_residual(record)
+        # fold this epoch's span ring into the self-time tree (status
+        # perf section + flight-recorder dumps); no-op telemetry-off
+        self.attributor.note_epoch(record)
         if self.metrics_path and self.primary:
             with open(self.metrics_path, "a") as f:
                 f.write(json.dumps(record) + "\n")

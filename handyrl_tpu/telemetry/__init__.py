@@ -16,9 +16,22 @@ Public surface (see :mod:`.spans` for the design notes):
   * metrics: ``summarize_lags`` (the per-epoch policy-version-lag
     reduction) and :class:`.histogram.LatencyHistogram` (mergeable
     fixed-bucket log2 latency histogram — the serving tier's p50/p99
-    accounting, reusable for any span family).
+    accounting, reusable for any span family);
+  * perf attribution: :mod:`.costmodel` (runtime MFU/roofline cost
+    accounting over the guarded jit programs — ``CostModel`` /
+    ``PerfConfig`` / the one ``DEVICE_PEAKS`` table bench shares) and
+    :mod:`.attribution` (the per-epoch self-time tree + the
+    ``untracked_residual_sec`` wall-time reconciliation), surfaced in
+    metrics.jsonl, the status ``perf`` section, and flight-recorder
+    dumps via ``register_dump_extra``.
 """
 
+from .attribution import (  # noqa: F401
+    Attributor,
+    self_time_tree,
+    untracked_residual,
+)
+from .costmodel import CostModel, PerfConfig  # noqa: F401
 from .histogram import LatencyHistogram  # noqa: F401
 from .spans import (  # noqa: F401
     TRACE_HEAD,
@@ -35,8 +48,11 @@ from .spans import (  # noqa: F401
     install_signal_dump,
     maybe_trace,
     new_trace,
+    now,
     payload_trace,
     record_span,
+    register_dump_extra,
+    ring_snapshot,
     set_trace,
     span_begin,
     span_end,

@@ -75,6 +75,10 @@ class _State:
         self.buffers = []             # every thread's span buffer
         self.span_file = None
         self.rng = None               # lazy; seeded per process
+        # name -> zero-arg callable whose result rides every flight-
+        # recorder dump (the attribution snapshot hooks in here);
+        # reset by configure() like the rest of the state
+        self.dump_extras = {}
 
 
 _state = _State()
@@ -124,6 +128,34 @@ def configure_from_args(args, role="", primary=True):
 
 def enabled():
     return _state.enabled
+
+
+def now():
+    """The telemetry clock's current stamp (injectable — tests drive
+    it; production is CLOCK_MONOTONIC, shared across processes)."""
+    return _state.clock()
+
+
+def ring_snapshot():
+    """A defensive copy of the flight-recorder ring (oldest first) —
+    the attribution fold's input.  Hot-path appends don't take the
+    lock, so retry a torn copy instead of crashing the reader."""
+    for _ in range(4):
+        try:
+            return list(_state.ring.copy())
+        except RuntimeError:  # deque mutated during iteration
+            continue
+    return []
+
+
+def register_dump_extra(name, fn):
+    """Attach ``fn()``'s result under ``doc[name]`` in every flight-
+    recorder dump (e.g. the last attribution snapshot rides next to
+    the span timeline).  A failing extra is skipped, never fatal;
+    reserved doc fields cannot be shadowed."""
+    if name in ("reason", "role", "pid", "dumped_at", "spans"):
+        raise ValueError(f"dump extra name {name!r} is reserved")
+    _state.dump_extras[name] = fn
 
 
 def stats():
@@ -383,6 +415,11 @@ def dump(reason, path=None):
             "dumped_at": round(state.clock(), 6),
             "spans": spans,
         }
+        for name, fn in list(state.dump_extras.items()):
+            try:
+                doc[name] = fn()
+            except Exception:
+                pass  # a dead extra must not block the post-mortem
         try:
             tmp = path + ".tmp"
             with open(tmp, "w") as f:

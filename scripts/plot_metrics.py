@@ -435,6 +435,50 @@ def plot(epochs, out_prefix):
                     bbox_inches="tight")
         print(f"wrote {out_prefix}_router.png")
 
+    # perf attribution (telemetry.costmodel/.attribution via the
+    # metrics jsonl): mfu and achieved_tflops are the roofline
+    # accounting — flat-and-low with a memory-bound verdict means the
+    # batch/fusion shape caps throughput, not scheduling; the right
+    # axis shows each epoch's wall decomposed into the batch-wait and
+    # untracked-residual SHARES (fractions of epoch_wall_sec), so a
+    # perf regression shows as one of the shares growing.  mfu is None
+    # on hosts with no peak table row and no perf.* override — the
+    # series() skip keeps those files plotting
+    perf_abs_keys = [k for k in ("mfu", "achieved_tflops")
+                     if any(e.get(k) is not None for e in epochs)]
+    perf_share_pairs = [
+        ("batch_wait_sec", "batch_wait share"),
+        ("untracked_residual_sec", "residual share"),
+    ]
+    have_shares = any(
+        e.get(k) is not None and (e.get("epoch_wall_sec") or 0) > 0
+        for e in epochs for k, _ in perf_share_pairs)
+    if perf_abs_keys or have_shares:
+        fig, ax = plt.subplots(figsize=(8, 5))
+        for k in perf_abs_keys:
+            pts = series(xs, epochs, k)
+            if pts:
+                ax.plot(*zip(*pts), label=k, marker=".")
+        ax.set_xlabel("epoch")
+        ax.set_ylabel("MFU (fraction) / achieved TFLOP/s")
+        ax2 = ax.twinx()
+        for k, label in perf_share_pairs:
+            pts = [(x, e[k] / e["epoch_wall_sec"])
+                   for x, e in zip(xs, epochs)
+                   if e.get(k) is not None
+                   and (e.get("epoch_wall_sec") or 0) > 0]
+            if pts:
+                ax2.plot(*zip(*pts), label=label, linestyle="--")
+        ax2.set_ylabel("share of epoch wall time")
+        ax2.set_ylim(bottom=0)
+        lines, labels = ax.get_legend_handles_labels()
+        lines2, labels2 = ax2.get_legend_handles_labels()
+        ax.legend(lines + lines2, labels + labels2, fontsize=8)
+        ax.grid(alpha=0.3)
+        fig.savefig(out_prefix + "_perf.png", dpi=120,
+                    bbox_inches="tight")
+        print(f"wrote {out_prefix}_perf.png")
+
     # generation stats (mean +- std band)
     pts = [(x, e["generation_mean"], e.get("generation_std", 0.0))
            for x, e in zip(xs, epochs) if "generation_mean" in e]

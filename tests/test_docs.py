@@ -11,6 +11,7 @@ from handyrl_tpu.config import TrainConfig, WorkerConfig
 from handyrl_tpu.pipeline.config import PipelineConfig
 from handyrl_tpu.resilience.chaos import ChaosConfig
 from handyrl_tpu.serving.config import RouterConfig, ServingConfig
+from handyrl_tpu.telemetry.costmodel import PerfConfig
 
 DOCS = os.path.join(os.path.dirname(__file__), "..", "docs",
                     "parameters.md")
@@ -41,6 +42,9 @@ def _config_keys():
         keys.add(field.name)  # the documented serving.* sub-keys
     for field in dataclasses.fields(RouterConfig):
         keys.add(field.name)  # the documented router.* sub-keys
+    # PerfConfig is a plain class, not a dataclass: its KEYS tuple is
+    # the validated perf.* key set
+    keys.update(PerfConfig.KEYS)
     keys.update({"env", "opponent"})  # env_args.env + eval.opponent
     return keys
 

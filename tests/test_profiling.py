@@ -1,0 +1,95 @@
+"""utils.profiling: the TraceWindow step-window state machine.
+
+The window drives ``jax.profiler`` start/stop from the update-step
+count; the tests stub the profiler (monkeypatched module attribute) so
+the semantics — start at ``start_step``, stop at ``stop_step``,
+one-shot, close-while-active flush, inactive with no ``trace_dir`` —
+are asserted without touching a real trace backend."""
+
+import jax
+import pytest
+
+from handyrl_tpu.utils.profiling import SectionTimers, TraceWindow
+
+
+class _StubProfiler:
+    def __init__(self):
+        self.calls = []
+
+    def start_trace(self, trace_dir):
+        self.calls.append(("start", trace_dir))
+
+    def stop_trace(self):
+        self.calls.append(("stop", None))
+
+
+@pytest.fixture()
+def profiler(monkeypatch):
+    stub = _StubProfiler()
+    monkeypatch.setattr(jax, "profiler", stub)
+    return stub
+
+
+def test_window_starts_and_stops_at_configured_steps(profiler):
+    win = TraceWindow("/tmp/tw", start_step=3, stop_step=5)
+    for _ in range(2):
+        win.tick()
+    assert profiler.calls == [] and not win.active
+    win.tick()                       # step 3: start fires
+    assert profiler.calls == [("start", "/tmp/tw")]
+    assert win.active and not win.done
+    win.tick()                       # step 4: inside the window
+    assert len(profiler.calls) == 1
+    win.tick()                       # step 5: stop fires, one-shot
+    assert profiler.calls[-1] == ("stop", None)
+    assert win.done and not win.active
+
+
+def test_window_is_one_shot_after_stop(profiler):
+    win = TraceWindow("/tmp/tw", start_step=1, stop_step=2)
+    for _ in range(6):
+        win.tick()
+    # exactly one start/stop pair no matter how many later ticks
+    assert profiler.calls == [("start", "/tmp/tw"), ("stop", None)]
+    assert win.step == 2             # done windows stop counting
+
+
+def test_close_while_active_stops_the_trace(profiler):
+    win = TraceWindow("/tmp/tw", start_step=1, stop_step=10)
+    win.tick()
+    assert win.active
+    win.close()                      # early shutdown mid-window
+    assert profiler.calls == [("start", "/tmp/tw"), ("stop", None)]
+    assert win.done and not win.active
+    win.tick()                       # and it stays closed
+    assert len(profiler.calls) == 2
+
+
+def test_close_when_never_started_is_a_noop(profiler):
+    win = TraceWindow("/tmp/tw", start_step=5, stop_step=6)
+    win.tick()
+    win.close()
+    assert profiler.calls == []
+    assert not win.active
+
+
+def test_empty_trace_dir_disables_the_window(profiler):
+    win = TraceWindow("", start_step=1, stop_step=2)
+    for _ in range(4):
+        win.tick()
+    win.close()
+    assert profiler.calls == []
+    assert win.done and win.step == 0
+
+
+def test_section_timers_accumulate_and_reset():
+    timers = SectionTimers()
+    with timers.section("update"):
+        pass
+    with timers.section("update"):
+        pass
+    snap = timers.snapshot()
+    assert snap["update"]["n"] == 2
+    assert snap["update"]["sec"] >= 0.0
+    # snapshot(reset=True) is the default: the next epoch starts clean
+    assert timers.snapshot() == {}

@@ -66,6 +66,11 @@ def test_local_training_two_epochs(tmp_path, monkeypatch):
             # carry the fd/thread/shm population, and the fleet must
             # PLATEAU after bring-up (the soak assert below)
             "resource_ledger": True,
+            # perf attribution armed with explicit peaks: CPU has no
+            # DEVICE_PEAKS row, so the override is what turns the
+            # roofline keys from None into real floats here (the same
+            # mechanism an unlisted accelerator would use)
+            "perf": {"peak_tflops": 1.0, "peak_hbm_gbs": 100.0},
             "metrics_path": "metrics.jsonl",
             # telemetry armed at the DEFAULT sample rate: the pipeline
             # metrics must land in every epoch record, and the span
@@ -141,6 +146,27 @@ def test_local_training_two_epochs(tmp_path, monkeypatch):
         assert record["queue_depth"] >= 0
         assert record["epoch_wall_sec"] > 0.0
         assert record["time_sec"] >= record["epoch_wall_sec"]
+        # perf attribution, present EVERY epoch: the cost model
+        # harvested the step program's flops at its one compile, and
+        # the peak override above makes mfu/achieved real floats on
+        # this CPU host; the roofline verdict must commit either way
+        assert isinstance(record["mfu"], float) and record["mfu"] > 0.0
+        assert isinstance(record["achieved_tflops"], float)
+        assert record["achieved_tflops"] > 0.0
+        assert record["arithmetic_intensity"] > 0.0
+        assert record["roofline_verdict"] in (
+            "compute-bound", "memory-bound")
+        # wall-time reconciliation, EXACT by construction: the epoch
+        # wall equals the tracked sections plus the explicit residual
+        # over the record's own rounded values (the attribution
+        # layer's no-hidden-time contract)
+        tracked = sum(v for k, v in record.items()
+                      if k.startswith("profile_") and k.endswith("_sec")
+                      and isinstance(v, (int, float)))
+        assert record["untracked_residual_sec"] == pytest.approx(
+            record["epoch_wall_sec"] - tracked, abs=1e-6)
+        assert tracked + record["untracked_residual_sec"] == \
+            pytest.approx(record["epoch_wall_sec"], abs=1e-6)
         # the inference dispatch carries the SAME guard contract as
         # the update step (GSPMD inference plane): zero resharding
         # copies every epoch, and the compile count never exceeds the
